@@ -1,0 +1,17 @@
+"""aiOS-TPU — a TPU-native rebuild of the aiOS "AI Operating System".
+
+Subpackages:
+  engine       JAX/XLA TPU inference engine (model, KV cache, batching,
+               sharding, sampling, GGUF loading) — replaces llama.cpp
+  ops          Pallas TPU kernels for the hot paths
+  runtime      aios.runtime.AIRuntime gRPC service over the engine
+  memory       three-tier memory service (aios.memory.MemoryService)
+  tools        capability-checked tool registry (aios.tools.ToolRegistry)
+  gateway      cloud/local inference router (aios.api_gateway.ApiGateway)
+  orchestrator goal engine, task planner, autonomy loop, scheduler, console
+  agents       Python agent framework + the 10 system agents
+  boot         topo-sorted service supervisor (initd equivalent)
+  native       C++ components (ring buffer, token bucket, audit hash chain)
+"""
+
+__version__ = "0.1.0"

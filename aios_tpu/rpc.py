@@ -1,0 +1,133 @@
+"""Programmatic gRPC stub/servicer construction.
+
+The environment has no grpcio-tools, so instead of checked-in generated
+`*_pb2_grpc.py` files each service is described once by a `ServiceSpec`
+(method name -> request/response classes + streaming flags) and this module
+builds, at import time, the same three artifacts grpcio-tools would emit:
+
+  * ``make_stub(spec)``      -> a Stub class taking a ``grpc.Channel``
+  * ``make_servicer(spec)``  -> an abstract Servicer base class
+  * ``add_to_server(spec, servicer, server)`` -> registers generic handlers
+
+All aiOS services are unary-unary or unary-stream; the builder supports all
+four cardinalities anyway for completeness.
+
+Reference parity: replaces the generated tonic (Rust) / grpcio (Python) stubs
+of agent-core/proto (SURVEY.md section 1, "IPC protos" row).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Tuple
+
+import grpc
+
+
+@dataclass(frozen=True)
+class Method:
+    """One RPC: request/response message classes and streaming flags."""
+
+    request: Any
+    response: Any
+    server_streaming: bool = False
+    client_streaming: bool = False
+
+    @property
+    def cardinality(self) -> str:
+        lhs = "stream" if self.client_streaming else "unary"
+        rhs = "stream" if self.server_streaming else "unary"
+        return f"{lhs}_{rhs}"
+
+
+@dataclass(frozen=True)
+class ServiceSpec:
+    """A full gRPC service: package-qualified name plus its method table."""
+
+    full_name: str  # e.g. "aios.runtime.AIRuntime"
+    methods: Dict[str, Method] = field(default_factory=dict)
+
+    def path(self, method: str) -> str:
+        return f"/{self.full_name}/{method}"
+
+
+def make_stub(spec: ServiceSpec) -> type:
+    """Build a Stub class equivalent to grpcio-tools' ``<Service>Stub``."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        for name, m in spec.methods.items():
+            factory = getattr(channel, m.cardinality)
+            setattr(
+                self,
+                name,
+                factory(
+                    spec.path(name),
+                    request_serializer=m.request.SerializeToString,
+                    response_deserializer=m.response.FromString,
+                ),
+            )
+
+    return type(
+        spec.full_name.rsplit(".", 1)[-1] + "Stub",
+        (object,),
+        {"__init__": __init__, "__doc__": f"Client stub for {spec.full_name}."},
+    )
+
+
+def make_servicer(spec: ServiceSpec) -> type:
+    """Build an abstract Servicer base (methods default to UNIMPLEMENTED)."""
+
+    def _unimplemented(name: str) -> Callable:
+        def method(self, request, context):  # noqa: ANN001
+            context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+            context.set_details(f"{name} is not implemented")
+            raise NotImplementedError(name)
+
+        method.__name__ = name
+        return method
+
+    body = {name: _unimplemented(name) for name in spec.methods}
+    body["__doc__"] = f"Servicer base for {spec.full_name}."
+    return type(spec.full_name.rsplit(".", 1)[-1] + "Servicer", (object,), body)
+
+
+def add_to_server(spec: ServiceSpec, servicer: Any, server: grpc.Server) -> None:
+    """Register ``servicer``'s methods on ``server`` under ``spec.full_name``."""
+    handlers = {}
+    for name, m in spec.methods.items():
+        handler_factory = getattr(grpc, f"{m.cardinality}_rpc_method_handler")
+        handlers[name] = handler_factory(
+            getattr(servicer, name),
+            request_deserializer=m.request.FromString,
+            response_serializer=m.response.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(spec.full_name, handlers),)
+    )
+
+
+def create_server(
+    max_workers: int = 16, options: Tuple[Tuple[str, Any], ...] | None = None
+) -> grpc.Server:
+    """A threaded gRPC server with aiOS-standard channel options."""
+    opts = list(
+        options
+        or (
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        )
+    )
+    return grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
+    )
+
+
+def insecure_channel(address: str) -> grpc.Channel:
+    return grpc.insecure_channel(
+        address,
+        options=[
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ],
+    )

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Generate Python protobuf message modules for the 7 aiOS proto packages.
+
+The image ships `protoc` (libprotoc 3.21) but not `grpcio-tools`, so we:
+  1. run `protoc --python_out` for the message classes, and
+  2. rewrite absolute imports to package-relative ones so the generated
+     modules live inside `aios_tpu.proto_gen`.
+
+gRPC stubs/servicers are NOT generated; they are built programmatically at
+import time by `aios_tpu.rpc` from the method tables in
+`aios_tpu.proto_gen.services` (equivalent surface to grpcio-tools output).
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PROTO_DIR = REPO / "aios_tpu" / "protos"
+OUT_DIR = REPO / "aios_tpu" / "proto_gen"
+
+PROTOS = [
+    "common.proto",
+    "runtime.proto",
+    "orchestrator.proto",
+    "agent.proto",
+    "tools.proto",
+    "api_gateway.proto",
+    "memory.proto",
+]
+
+
+def main() -> int:
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "protoc",
+        f"--proto_path={PROTO_DIR}",
+        f"--python_out={OUT_DIR}",
+        *PROTOS,
+    ]
+    subprocess.run(cmd, check=True, cwd=PROTO_DIR)
+
+    # protoc emits `import common_pb2 as common__pb2`; make it relative.
+    for py in OUT_DIR.glob("*_pb2.py"):
+        text = py.read_text()
+        fixed = re.sub(
+            r"^import (\w+_pb2) as", r"from . import \1 as", text, flags=re.M
+        )
+        py.write_text(fixed)
+
+    init = OUT_DIR / "__init__.py"
+    names = [p.replace(".proto", "_pb2") for p in PROTOS]
+    init.write_text(
+        '"""Generated protobuf modules (see scripts/gen_protos.py)."""\n'
+        + "".join(f"from . import {n}\n" for n in names)
+        + "\n__all__ = [\n"
+        + "".join(f'    "{n}",\n' for n in names)
+        + "]\n"
+    )
+    print(f"generated {len(names)} modules into {OUT_DIR}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

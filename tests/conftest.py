@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so every sharding path (TP/DP/SP)
+is exercised without TPU hardware; the driver separately compile-checks the
+real-chip path. Env vars must be set before the first `import jax` anywhere
+in the test process, which is why this lives at the top of conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices
+
+
+@pytest.fixture()
+def tmp_db_path(tmp_path):
+    return str(tmp_path / "test.db")
