@@ -1,0 +1,6 @@
+"""The JAX/XLA TPU inference + training engine.
+
+This package is the replacement for the reference's llama.cpp backend
+(runtime/src/, SURVEY.md section 2.3): weights land as HBM-resident sharded
+bf16 params and the decode loop is a single jitted graph.
+"""

@@ -1,0 +1,228 @@
+"""GGUF loader: container round-trip + dequantization correctness.
+
+The K-quant dequantizers are validated against independent scalar
+implementations written directly from the ggml block-layout spec, evaluated
+on random block bytes — any disagreement between the vectorized numpy path
+and the scalar path fails the test.
+"""
+
+import numpy as np
+import pytest
+
+from aios_tpu.engine import gguf
+
+
+def _rand_blocks(n_blocks, n_bytes, seed):
+    rng = np.random.default_rng(seed)
+    blocks = rng.integers(0, 256, size=(n_blocks, n_bytes), dtype=np.uint8)
+    # keep the f16 scale fields finite and sane: overwrite with small floats
+    return blocks
+
+
+def _set_f16(blocks, col, values):
+    blocks[:, col : col + 2] = (
+        np.asarray(values, dtype=np.float16).view(np.uint8).reshape(-1, 2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+def test_container_roundtrip(tmp_path):
+    path = tmp_path / "m.gguf"
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 64)).astype(np.float32)
+    h = rng.standard_normal((4, 32)).astype(np.float16)
+    meta = {
+        "general.architecture": "llama",
+        "llama.block_count": 22,
+        "llama.rope.freq_base": 10000.0,
+        "tokenizer.ggml.tokens": ["<s>", "</s>", "hello"],
+        "tokenizer.ggml.scores": [0.0, -1.0, -2.0],
+        "some.flag": True,
+    }
+    gguf.write_gguf(
+        path,
+        meta,
+        {
+            "blk.0.attn_q.weight": (w.shape, gguf.F32, w.tobytes()),
+            "blk.0.attn_k.weight": (h.shape, gguf.F16, h.tobytes()),
+        },
+    )
+    f = gguf.GGUFFile(path)
+    assert f.architecture == "llama"
+    assert f.metadata["llama.block_count"] == 22
+    assert f.metadata["tokenizer.ggml.tokens"] == ["<s>", "</s>", "hello"]
+    assert f.metadata["some.flag"] is True
+    assert f.metadata["llama.rope.freq_base"] == pytest.approx(10000.0)
+
+    got_w = f.load_tensor("blk.0.attn_q.weight")
+    np.testing.assert_array_equal(got_w, w)
+    got_h = f.load_tensor("blk.0.attn_k.weight")
+    np.testing.assert_allclose(got_h, h.astype(np.float32))
+
+
+def test_bf16_dequant():
+    x = np.array([1.5, -2.25, 0.0, 1e10], dtype=np.float32)
+    bf = (x.view(np.uint32) >> 16).astype(np.uint16)
+    out = gguf.dequantize(bf.view(np.uint8), gguf.BF16, 4)
+    # bf16 truncation: compare against numpy's own truncation
+    expected = (bf.astype(np.uint32) << 16).view(np.float32)
+    np.testing.assert_array_equal(out, expected)
+
+
+# ---------------------------------------------------------------------------
+# Simple quant round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_q8_0_roundtrip():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal(32 * 64).astype(np.float32)
+    raw = gguf.quantize_q8_0(x)
+    y = gguf.dequantize(raw, gguf.Q8_0, x.size)
+    # 8-bit block quant: relative block error bounded by ~1/127 of block max
+    err = np.abs(x - y).max()
+    assert err < np.abs(x).max() / 127 * 1.1
+
+
+def test_q4_0_roundtrip():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal(32 * 64).astype(np.float32)
+    raw = gguf.quantize_q4_0(x)
+    y = gguf.dequantize(raw, gguf.Q4_0, x.size)
+    blocks = x.reshape(-1, 32)
+    per_block_scale = np.abs(blocks).max(axis=1, keepdims=True) / 8.0
+    assert np.all(np.abs(blocks - y.reshape(-1, 32)) <= per_block_scale * 1.01)
+
+
+# ---------------------------------------------------------------------------
+# K-quants vs independent scalar reference
+# ---------------------------------------------------------------------------
+
+
+def _scale_min_k4(j, s):
+    if j < 4:
+        return s[j] & 63, s[j + 4] & 63
+    sc = (s[j + 4] & 0x0F) | ((s[j - 4] >> 6) << 4)
+    mn = (s[j + 4] >> 4) | ((s[j] >> 6) << 4)
+    return sc, mn
+
+
+def _scalar_q4_k(block):
+    d = np.frombuffer(block[0:2].tobytes(), dtype=np.float16)[0].astype(np.float32)
+    dmin = np.frombuffer(block[2:4].tobytes(), dtype=np.float16)[0].astype(np.float32)
+    s = block[4:16]
+    qs = block[16:144]
+    out = np.zeros(256, dtype=np.float32)
+    y = 0
+    is_ = 0
+    q = 0
+    for _ in range(4):  # chunks of 64
+        sc1, m1 = _scale_min_k4(is_, s)
+        sc2, m2 = _scale_min_k4(is_ + 1, s)
+        for l in range(32):
+            out[y + l] = d * sc1 * (qs[q + l] & 0x0F) - dmin * m1
+        for l in range(32):
+            out[y + 32 + l] = d * sc2 * (qs[q + l] >> 4) - dmin * m2
+        y += 64
+        q += 32
+        is_ += 2
+    return out
+
+
+def _scalar_q5_k(block):
+    d = np.frombuffer(block[0:2].tobytes(), dtype=np.float16)[0].astype(np.float32)
+    dmin = np.frombuffer(block[2:4].tobytes(), dtype=np.float16)[0].astype(np.float32)
+    s = block[4:16]
+    qh = block[16:48]
+    ql = block[48:176]
+    out = np.zeros(256, dtype=np.float32)
+    y = 0
+    is_ = 0
+    q = 0
+    u1, u2 = 1, 2
+    for _ in range(4):
+        sc1, m1 = _scale_min_k4(is_, s)
+        sc2, m2 = _scale_min_k4(is_ + 1, s)
+        for l in range(32):
+            hi = 16 if (qh[l] & u1) else 0
+            out[y + l] = d * sc1 * ((ql[q + l] & 0x0F) + hi) - dmin * m1
+        for l in range(32):
+            hi = 16 if (qh[l] & u2) else 0
+            out[y + 32 + l] = d * sc2 * ((ql[q + l] >> 4) + hi) - dmin * m2
+        y += 64
+        q += 32
+        is_ += 2
+        u1 <<= 2
+        u2 <<= 2
+    return out
+
+
+def _scalar_q6_k(block):
+    ql = block[0:128]
+    qh = block[128:192]
+    sc = block[192:208].view(np.int8)
+    d = np.frombuffer(block[208:210].tobytes(), dtype=np.float16)[0].astype(np.float32)
+    out = np.zeros(256, dtype=np.float32)
+    for n in (0, 128):
+        lo = n // 2
+        ho = n // 4
+        so = n // 16
+        for l in range(32):
+            is_ = l // 16
+            q1 = ((int(ql[lo + l]) & 0x0F) | (((int(qh[ho + l]) >> 0) & 3) << 4)) - 32
+            q2 = ((int(ql[lo + l + 32]) & 0x0F) | (((int(qh[ho + l]) >> 2) & 3) << 4)) - 32
+            q3 = ((int(ql[lo + l]) >> 4) | (((int(qh[ho + l]) >> 4) & 3) << 4)) - 32
+            q4 = ((int(ql[lo + l + 32]) >> 4) | (((int(qh[ho + l]) >> 6) & 3) << 4)) - 32
+            out[n + l] = d * sc[so + is_] * q1
+            out[n + l + 32] = d * sc[so + is_ + 2] * q2
+            out[n + l + 64] = d * sc[so + is_ + 4] * q3
+            out[n + l + 96] = d * sc[so + is_ + 6] * q4
+    return out
+
+
+@pytest.mark.parametrize(
+    "ggml_type,scalar_fn,d_cols",
+    [
+        (gguf.Q4_K, _scalar_q4_k, (0, 2)),
+        (gguf.Q5_K, _scalar_q5_k, (0, 2)),
+        (gguf.Q6_K, _scalar_q6_k, (208,)),
+    ],
+)
+def test_k_quants_match_scalar_reference(ggml_type, scalar_fn, d_cols):
+    elems, nbytes = gguf.BLOCK_LAYOUT[ggml_type]
+    n_blocks = 16
+    blocks = _rand_blocks(n_blocks, nbytes, seed=ggml_type)
+    rng = np.random.default_rng(99)
+    for col in d_cols:
+        _set_f16(blocks, col, rng.uniform(0.001, 0.1, size=n_blocks))
+    vectorized = gguf.dequantize(blocks.reshape(-1), ggml_type, n_blocks * elems)
+    scalar = np.concatenate([scalar_fn(blocks[i]) for i in range(n_blocks)])
+    np.testing.assert_allclose(vectorized, scalar, rtol=1e-5, atol=1e-6)
+
+
+def test_q5_0_against_scalar():
+    n_blocks = 8
+    blocks = _rand_blocks(n_blocks, 22, seed=7)
+    _set_f16(blocks, 0, np.full(n_blocks, 0.05))
+    out = gguf.dequantize(blocks.reshape(-1), gguf.Q5_0, n_blocks * 32)
+    for i in range(n_blocks):
+        b = blocks[i]
+        d = np.frombuffer(b[0:2].tobytes(), dtype=np.float16)[0].astype(np.float32)
+        qh = int.from_bytes(b[2:6].tobytes(), "little")
+        qs = b[6:22]
+        for l in range(32):
+            nib = (int(qs[l]) & 0x0F) if l < 16 else (int(qs[l - 16]) >> 4)
+            q = (nib | (((qh >> l) & 1) << 4)) - 16
+            assert out[i * 32 + l] == pytest.approx(d * q, rel=1e-5)
+
+
+def test_tensor_info_byte_sizes():
+    info = gguf.TensorInfo("t", (64, 256), gguf.Q4_K, 0)
+    assert info.n_elements == 64 * 256
+    assert info.n_bytes == 64 * 256 // 256 * 144
+    info2 = gguf.TensorInfo("t2", (4, 32), gguf.Q8_0, 0)
+    assert info2.n_bytes == 4 * 34
