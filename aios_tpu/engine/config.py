@@ -1,0 +1,199 @@
+"""Model configurations for the Llama-family decoder.
+
+One architecture class covers every local model tier the reference routes to
+(runtime/src/model_manager.rs:462-518): TinyLlama-1.1B (operational),
+Mistral-7B (tactical, GQA + sliding window), DeepSeek-R1-Distill-8B
+(tactical, Llama-3 shape), Qwen3-14B (strategic, QK-norm). Configs can be
+built from presets, GGUF metadata, or HF config dicts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_context: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    sliding_window: Optional[int] = None
+    tie_word_embeddings: bool = False
+    qk_norm: bool = False  # Qwen3-style per-head RMSNorm on q/k
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def group_size(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def scaled(self, **overrides) -> "ModelConfig":
+        return replace(self, **overrides)
+
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        e = self.vocab_size * self.hidden_size
+        attn = self.hidden_size * self.q_dim * 2 + self.hidden_size * self.kv_dim * 2
+        mlp = 3 * self.hidden_size * self.intermediate_size
+        norms = 2 * self.hidden_size
+        head = 0 if self.tie_word_embeddings else e
+        return e + self.num_layers * (attn + mlp + norms) + self.hidden_size + head
+
+
+# ---------------------------------------------------------------------------
+# Presets — the model tiers of the reference intelligence hierarchy
+# ---------------------------------------------------------------------------
+
+TINYLLAMA_1_1B = ModelConfig(
+    name="tinyllama-1.1b",
+    vocab_size=32000,
+    hidden_size=2048,
+    intermediate_size=5632,
+    num_layers=22,
+    num_heads=32,
+    num_kv_heads=4,
+    head_dim=64,
+    max_context=2048,
+    rope_theta=10000.0,
+)
+
+MISTRAL_7B = ModelConfig(
+    name="mistral-7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    max_context=8192,
+    rope_theta=10000.0,
+    sliding_window=4096,
+)
+
+DEEPSEEK_R1_8B = ModelConfig(
+    # DeepSeek-R1-Distill-Llama-8B: Llama-3.1-8B geometry
+    name="deepseek-r1-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    max_context=8192,
+    rope_theta=500000.0,
+)
+
+QWEN3_14B = ModelConfig(
+    name="qwen3-14b",
+    vocab_size=151936,
+    hidden_size=5120,
+    intermediate_size=17408,
+    num_layers=40,
+    num_heads=40,
+    num_kv_heads=8,
+    head_dim=128,
+    max_context=8192,
+    rope_theta=1000000.0,
+    rms_norm_eps=1e-6,
+    qk_norm=True,
+)
+
+PRESETS: Dict[str, ModelConfig] = {
+    c.name: c for c in (TINYLLAMA_1_1B, MISTRAL_7B, DEEPSEEK_R1_8B, QWEN3_14B)
+}
+
+# Tiny variants for tests / dry runs (same code paths, trivial sizes).
+TINY_TEST = ModelConfig(
+    name="tiny-test",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    max_context=128,
+)
+
+
+def resolve(name: str) -> ModelConfig:
+    """Case-insensitive partial matching, like the reference's
+    select_model_for_level (model_manager.rs:506-518)."""
+    low = name.lower()
+    if low in PRESETS:
+        return PRESETS[low]
+    for key, cfg in PRESETS.items():
+        if low in key or key in low:
+            return cfg
+    raise KeyError(f"unknown model config: {name}")
+
+
+def from_gguf_metadata(md: Dict[str, Any]) -> ModelConfig:
+    """Build a config from GGUF metadata keys (llama/mistral/qwen archs)."""
+    arch = md.get("general.architecture", "llama")
+
+    def key(suffix: str, default=None):
+        return md.get(f"{arch}.{suffix}", default)
+
+    heads = int(key("attention.head_count"))
+    kv_heads = int(key("attention.head_count_kv", heads))
+    hidden = int(key("embedding_length"))
+    head_dim = int(key("attention.key_length", hidden // heads))
+    vocab = int(md.get("tokenizer.ggml.tokens and vocab", 0)) or len(
+        md.get("tokenizer.ggml.tokens", [])
+    ) or int(key("vocab_size", 32000))
+    return ModelConfig(
+        name=md.get("general.name", arch).lower().replace(" ", "-"),
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=int(key("feed_forward_length")),
+        num_layers=int(key("block_count")),
+        num_heads=heads,
+        num_kv_heads=kv_heads,
+        head_dim=head_dim,
+        max_context=int(key("context_length", 4096)),
+        rope_theta=float(key("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        sliding_window=(
+            int(key("attention.sliding_window")) if key("attention.sliding_window") else None
+        ),
+        qk_norm=arch.startswith("qwen3"),
+    )
+
+
+def from_hf_config(hf: Dict[str, Any], name: str = "hf-model") -> ModelConfig:
+    """Build a config from a HuggingFace config dict (Llama/Mistral/Qwen3)."""
+    heads = hf["num_attention_heads"]
+    return ModelConfig(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
+        max_context=hf.get("max_position_embeddings", 4096),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        sliding_window=hf.get("sliding_window"),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        qk_norm=hf.get("model_type", "") == "qwen3",
+    )

@@ -1,0 +1,284 @@
+"""Functional JAX implementation of the Llama-family decoder.
+
+One code path serves TinyLlama-1.1B, Mistral-7B (GQA + sliding window),
+DeepSeek-R1-Distill-8B and Qwen3-14B (QK-norm) — the four local tiers of the
+reference intelligence hierarchy (SURVEY.md section 2.3). The design is
+TPU-first:
+
+  * layer parameters are stacked on a leading axis and the block stack runs
+    under `jax.lax.scan` — one traced layer, fast compiles, XLA-friendly;
+  * all matmuls are bf16 einsums (MXU), normalization/softmax accumulate in
+    fp32;
+  * masks are computed from positions with static shapes — no dynamic shapes
+    anywhere, so prefill/decode jit cleanly onto the MXU;
+  * three entry points: `forward_full` (training/parity), `prefill`
+    (returns per-layer K/V for cache insertion), `decode_step` (batched
+    single-token step over a slot cache — the continuous-batching hot loop).
+
+Params pytree layout (E=hidden, Q=heads*head_dim, K=kv_heads*head_dim,
+F=intermediate, L=layers, V=vocab, D=head_dim):
+
+  embed      [V, E]
+  layers/attn_norm [L, E]   layers/ffn_norm [L, E]
+  layers/wq  [L, E, Q]      layers/wk [L, E, K]   layers/wv [L, E, K]
+  layers/wo  [L, Q, E]
+  layers/w_gate [L, E, F]   layers/w_up [L, E, F] layers/w_down [L, F, E]
+  layers/q_norm [L, D]      layers/k_norm [L, D]      (only if cfg.qk_norm)
+  final_norm [E]
+  lm_head    [E, V]                                   (absent if tied)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+Params = Dict[str, jnp.ndarray]
+
+
+# ---------------------------------------------------------------------------
+# Primitives
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm with fp32 accumulation, output in x.dtype."""
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * weight
+
+
+def rope_tables(
+    positions: jnp.ndarray, head_dim: int, theta: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for the given absolute positions.
+
+    Returns arrays of shape positions.shape + (head_dim,) using the
+    half-rotation (HF transformers) convention: the frequency vector is
+    duplicated across the two halves of the head dimension.
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    angles = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate q or k. x: [B, T, H, D]; cos/sin: [B, T, D]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    cos = cos[..., None, :]  # broadcast over heads
+    sin = sin[..., None, :]
+    return (x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin).astype(
+        x.dtype
+    )
+
+
+def gqa_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, S, KH, D]
+    v: jnp.ndarray,  # [B, S, KH, D]
+    mask: jnp.ndarray,  # bool [B, T, S] or [T, S]
+) -> jnp.ndarray:
+    """Grouped-query attention, fp32 softmax. Returns [B, T, H, D]."""
+    B, T, H, D = q.shape
+    KH = k.shape[2]
+    G = H // KH
+    q = q.reshape(B, T, KH, G, D)
+    scores = jnp.einsum("btkgd,bskd->bkgts", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(D)
+    if mask.ndim == 2:
+        mask = mask[None]
+    scores = jnp.where(mask[:, None, None, :, :], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(B, T, H, D)
+
+
+def causal_mask(T: int, window: Optional[int]) -> jnp.ndarray:
+    """[T, T] causal (optionally sliding-window) mask."""
+    rows = jnp.arange(T)[:, None]
+    cols = jnp.arange(T)[None, :]
+    m = cols <= rows
+    if window is not None:
+        m = m & (cols > rows - window)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# One transformer block (shared by all entry points)
+# ---------------------------------------------------------------------------
+
+
+def _project_qkv(x, lp, cfg: ModelConfig, cos, sin):
+    B, T, E = x.shape
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    return q, k, v
+
+
+def _mlp(x, lp, cfg: ModelConfig):
+    h = rms_norm(x, lp["ffn_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    return (gate * (h @ lp["w_up"])) @ lp["w_down"]
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def forward_full(
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray
+) -> jnp.ndarray:
+    """Full-sequence causal forward; logits [B, T, V] in fp32.
+
+    Used for training, numeric-parity testing and as the prefill core.
+    """
+    logits, _, _ = _forward_with_kv(params, cfg, tokens)
+    return logits
+
+
+def prefill(
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Causal forward returning (logits [B,T,V], k [L,B,T,KH,D], v [...]).
+
+    The engine copies the returned K/V into the request's cache slot.
+    """
+    return _forward_with_kv(params, cfg, tokens)
+
+
+def _forward_with_kv(params, cfg: ModelConfig, tokens):
+    B, T = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    mask = causal_mask(T, cfg.sliding_window)
+
+    def block(x, lp):
+        q, k, v = _project_qkv(x, lp, cfg, cos, sin)
+        attn = gqa_attention(q, k, v, mask)
+        x = x + attn.reshape(B, T, -1) @ lp["wo"]
+        x = x + _mlp(x, lp, cfg)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] int32 — one new token per slot
+    lengths: jnp.ndarray,  # [B] int32 — tokens already in each slot's cache
+    k_cache: jnp.ndarray,  # [L, B, C, KH, D]
+    v_cache: jnp.ndarray,  # [L, B, C, KH, D]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One batched decode step over the slot cache.
+
+    Writes the new K/V at row ``lengths[b]`` of each slot, attends over all
+    valid rows (with sliding window if configured), and returns
+    (logits [B, V] fp32, k_cache', v_cache'). Intended to be jitted with the
+    caches donated so XLA updates them in place.
+    """
+    B = tokens.shape[0]
+    C = k_cache.shape[2]
+    x = params["embed"][tokens][:, None, :]  # [B, 1, E]
+    cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
+
+    batch_idx = jnp.arange(B)
+    cols = jnp.arange(C)[None, :]
+    # column j is visible if it holds a written token (j <= lengths, since we
+    # write the new token before attending) and inside the sliding window
+    mask = cols <= lengths[:, None]
+    if cfg.sliding_window is not None:
+        mask = mask & (cols > (lengths[:, None] - cfg.sliding_window))
+    mask = mask[:, None, :]  # [B, 1, C]
+
+    def block(x, layer):
+        lp, k_l, v_l = layer
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        k_l = k_l.at[batch_idx, lengths].set(k_new[:, 0])
+        v_l = v_l.at[batch_idx, lengths].set(v_new[:, 0])
+        attn = gqa_attention(q, k_l, v_l, mask)
+        x = x + attn.reshape(B, 1, -1) @ lp["wo"]
+        x = x + _mlp(x, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        block, x, (params["layers"], k_cache, v_cache)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x[:, 0] @ head).astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """Random params (scaled-normal init) — for tests, benches and training."""
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(shape, scale=0.02):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(
+            dtype
+        )
+
+    L, E, F, D = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    layers = {
+        "attn_norm": jnp.ones((L, E), dtype),
+        "ffn_norm": jnp.ones((L, E), dtype),
+        "wq": normal((L, E, cfg.q_dim)),
+        "wk": normal((L, E, cfg.kv_dim)),
+        "wv": normal((L, E, cfg.kv_dim)),
+        "wo": normal((L, cfg.q_dim, E)),
+        "w_gate": normal((L, E, F)),
+        "w_up": normal((L, E, F)),
+        "w_down": normal((L, F, E)),
+    }
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, D), dtype)
+        layers["k_norm"] = jnp.ones((L, D), dtype)
+    params: Params = {
+        "embed": normal((cfg.vocab_size, E)),
+        "layers": layers,
+        "final_norm": jnp.ones((E,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = normal((E, cfg.vocab_size))
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_slots: int, max_len: int, dtype=jnp.bfloat16
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,147 @@
+"""Weight loading: GGUF files and HF checkpoints -> engine params.
+
+Replaces the reference's model-file handling (runtime/src/model_manager.rs
+auto-loads `*.gguf` from AIOS_MODEL_DIR); here GGUF tensors are dequantized
+host-side (engine/gguf.py) and stacked into the scan-ready [L, ...] layout of
+engine/model.py, ready for `jax.device_put` with mesh shardings.
+
+Two subtleties handled here:
+  * llama.cpp's GGUF converter permutes attn_q/attn_k rows from the HF
+    half-rotation RoPE layout to its interleaved layout; our model uses the
+    HF convention, so llama-arch GGUF q/k weights are inverse-permuted.
+  * GGUF/HF linear weights are stored (out, in); the engine stores (in, out)
+    so forward passes are plain `x @ w` einsums.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from . import gguf as gguf_mod
+from .config import ModelConfig, from_gguf_metadata
+
+Array = np.ndarray
+
+
+def _unpermute_llamacpp(w: Array, n_heads: int) -> Array:
+    """Invert convert_hf_to_gguf's q/k row permutation (interleaved -> HF)."""
+    out_dim, in_dim = w.shape
+    half = out_dim // n_heads // 2
+    return (
+        w.reshape(n_heads, half, 2, in_dim)
+        .swapaxes(1, 2)
+        .reshape(out_dim, in_dim)
+    )
+
+
+def _stack(layers: list) -> Dict[str, Array]:
+    return {k: np.stack([layer[k] for layer in layers]) for k in layers[0]}
+
+
+# ---------------------------------------------------------------------------
+# GGUF
+# ---------------------------------------------------------------------------
+
+
+def params_from_gguf(
+    path: str, cfg: ModelConfig | None = None, dtype=np.float32
+) -> tuple[Dict, ModelConfig]:
+    """Load a GGUF model file into engine params. Returns (params, config)."""
+    f = gguf_mod.GGUFFile(path)
+    if cfg is None:
+        cfg = from_gguf_metadata(f.metadata)
+    permute_qk = f.architecture in ("llama", "mistral")
+
+    def t(name: str) -> Array:
+        return f.load_tensor(name, dtype=dtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"blk.{i}."
+        wq = t(p + "attn_q.weight")
+        wk = t(p + "attn_k.weight")
+        if permute_qk:
+            wq = _unpermute_llamacpp(wq, cfg.num_heads)
+            wk = _unpermute_llamacpp(wk, cfg.num_kv_heads)
+        layer = {
+            "attn_norm": t(p + "attn_norm.weight"),
+            "ffn_norm": t(p + "ffn_norm.weight"),
+            "wq": wq.T,
+            "wk": wk.T,
+            "wv": t(p + "attn_v.weight").T,
+            "wo": t(p + "attn_output.weight").T,
+            "w_gate": t(p + "ffn_gate.weight").T,
+            "w_up": t(p + "ffn_up.weight").T,
+            "w_down": t(p + "ffn_down.weight").T,
+        }
+        if cfg.qk_norm:
+            layer["q_norm"] = t(p + "attn_q_norm.weight")
+            layer["k_norm"] = t(p + "attn_k_norm.weight")
+        layers.append(layer)
+
+    params = {
+        "embed": t("token_embd.weight"),
+        "layers": _stack(layers),
+        "final_norm": t("output_norm.weight"),
+    }
+    if "output.weight" in f.tensors:
+        params["lm_head"] = t("output.weight").T
+    return params, cfg
+
+
+# ---------------------------------------------------------------------------
+# HF transformers state dicts (parity tests + safetensors checkpoints)
+# ---------------------------------------------------------------------------
+
+
+def params_from_hf_state_dict(
+    sd: Dict[str, Array], cfg: ModelConfig, dtype=np.float32
+) -> Dict:
+    """Convert a transformers Llama/Mistral/Qwen3 state dict to engine params.
+
+    ``sd`` values may be torch tensors or numpy arrays.
+    """
+
+    def get(name: str) -> Array:
+        v = sd[name]
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        return np.asarray(v, dtype=dtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        layer = {
+            "attn_norm": get(p + "input_layernorm.weight"),
+            "ffn_norm": get(p + "post_attention_layernorm.weight"),
+            "wq": get(p + "self_attn.q_proj.weight").T,
+            "wk": get(p + "self_attn.k_proj.weight").T,
+            "wv": get(p + "self_attn.v_proj.weight").T,
+            "wo": get(p + "self_attn.o_proj.weight").T,
+            "w_gate": get(p + "mlp.gate_proj.weight").T,
+            "w_up": get(p + "mlp.up_proj.weight").T,
+            "w_down": get(p + "mlp.down_proj.weight").T,
+        }
+        if cfg.qk_norm:
+            layer["q_norm"] = get(p + "self_attn.q_norm.weight")
+            layer["k_norm"] = get(p + "self_attn.k_norm.weight")
+        layers.append(layer)
+
+    params = {
+        "embed": get("model.embed_tokens.weight"),
+        "layers": _stack(layers),
+        "final_norm": get("model.norm.weight"),
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = get("lm_head.weight").T
+    return params
+
+
+def map_params(params: Dict, fn: Callable[[Array], Array]) -> Dict:
+    """Apply ``fn`` to every leaf array (e.g. dtype casts, device_put)."""
+    out = {}
+    for k, v in params.items():
+        out[k] = map_params(v, fn) if isinstance(v, dict) else fn(v)
+    return out

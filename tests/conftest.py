@@ -8,12 +8,20 @@ in the test process, which is why this lives at the top of conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the session env may point JAX at the real TPU chip (and a site
+# hook can force jax_platforms after import), but the test suite runs on a
+# virtual 8-device CPU mesh — the driver benches on TPU separately. Both the
+# env var and the config override are needed, before backends initialize.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,256 @@
+"""Numeric parity: engine logits vs HuggingFace transformers on CPU fp32.
+
+The reference has no numeric tests (its kernels live in llama.cpp); this
+suite is the TPU build's ground truth (SURVEY.md section 4 "ours to invent").
+Tiny random-weight models exercise every architectural feature: GQA
+(TinyLlama/Llama shapes), sliding-window attention (Mistral), QK-norm
+(Qwen3), and the llama.cpp GGUF q/k permutation.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from aios_tpu.engine import gguf as gguf_mod
+from aios_tpu.engine import model as M
+from aios_tpu.engine import weights as W
+from aios_tpu.engine.config import ModelConfig
+
+ATOL = 2e-4
+RTOL = 2e-4
+
+
+def _hf_logits(hf_model, tokens):
+    with torch.no_grad():
+        out = hf_model(torch.tensor(tokens, dtype=torch.long))
+    return out.logits.float().numpy()
+
+
+def _engine_logits(hf_model, cfg, tokens):
+    params = W.params_from_hf_state_dict(hf_model.state_dict(), cfg)
+    return np.asarray(M.forward_full(params, cfg, tokens))
+
+
+def _tokens(cfg, batch=2, seq=24, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def llama_pair():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_hidden_layers=3,
+        num_attention_heads=8,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        name="tiny-llama-test",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_layers=3,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=8,
+        max_context=64,
+    )
+    return hf, cfg
+
+
+def test_llama_logits_parity(llama_pair):
+    hf, cfg = llama_pair
+    tokens = _tokens(cfg)
+    np.testing.assert_allclose(
+        _engine_logits(hf, cfg, tokens), _hf_logits(hf, tokens), atol=ATOL, rtol=RTOL
+    )
+
+
+def test_mistral_sliding_window_parity():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_hidden_layers=3,
+        num_attention_heads=8,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        sliding_window=8,  # shorter than seq so the window actually bites
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    hf = MistralForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        name="tiny-mistral-test",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_layers=3,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=8,
+        max_context=128,
+        sliding_window=8,
+    )
+    tokens = _tokens(cfg, seq=32, seed=3)
+    np.testing.assert_allclose(
+        _engine_logits(hf, cfg, tokens), _hf_logits(hf, tokens), atol=ATOL, rtol=RTOL
+    )
+
+
+def test_qwen3_qk_norm_parity():
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    hf_cfg = Qwen3Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=2,
+        head_dim=8,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    hf = Qwen3ForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        name="tiny-qwen3-test",
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=8,
+        max_context=64,
+        rms_norm_eps=1e-6,
+        qk_norm=True,
+    )
+    tokens = _tokens(cfg, seq=16, seed=5)
+    np.testing.assert_allclose(
+        _engine_logits(hf, cfg, tokens), _hf_logits(hf, tokens), atol=ATOL, rtol=RTOL
+    )
+
+
+def _permute_llamacpp(w, n_heads):
+    """The forward permutation convert_hf_to_gguf applies to q/k rows."""
+    out_dim, in_dim = w.shape
+    half = out_dim // n_heads // 2
+    return w.reshape(n_heads, 2, half, in_dim).swapaxes(1, 2).reshape(out_dim, in_dim)
+
+
+def test_gguf_roundtrip_matches_hf(llama_pair, tmp_path):
+    """HF weights -> GGUF container (with llama.cpp q/k permutation) ->
+    params_from_gguf must equal the HF-direct path bit-for-bit (F32)."""
+    hf, cfg = llama_pair
+    sd = {k: v.detach().numpy().astype(np.float32) for k, v in hf.state_dict().items()}
+
+    tensors = {}
+
+    def put(name, arr):
+        tensors[name] = (arr.shape, gguf_mod.F32, np.ascontiguousarray(arr).tobytes())
+
+    put("token_embd.weight", sd["model.embed_tokens.weight"])
+    put("output_norm.weight", sd["model.norm.weight"])
+    put("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_layers):
+        hp = f"model.layers.{i}."
+        gp = f"blk.{i}."
+        put(gp + "attn_norm.weight", sd[hp + "input_layernorm.weight"])
+        put(gp + "ffn_norm.weight", sd[hp + "post_attention_layernorm.weight"])
+        put(
+            gp + "attn_q.weight",
+            _permute_llamacpp(sd[hp + "self_attn.q_proj.weight"], cfg.num_heads),
+        )
+        put(
+            gp + "attn_k.weight",
+            _permute_llamacpp(sd[hp + "self_attn.k_proj.weight"], cfg.num_kv_heads),
+        )
+        put(gp + "attn_v.weight", sd[hp + "self_attn.v_proj.weight"])
+        put(gp + "attn_output.weight", sd[hp + "self_attn.o_proj.weight"])
+        put(gp + "ffn_gate.weight", sd[hp + "mlp.gate_proj.weight"])
+        put(gp + "ffn_up.weight", sd[hp + "mlp.up_proj.weight"])
+        put(gp + "ffn_down.weight", sd[hp + "mlp.down_proj.weight"])
+
+    meta = {
+        "general.architecture": "llama",
+        "general.name": "tiny-llama-test",
+        "llama.block_count": cfg.num_layers,
+        "llama.embedding_length": cfg.hidden_size,
+        "llama.feed_forward_length": cfg.intermediate_size,
+        "llama.attention.head_count": cfg.num_heads,
+        "llama.attention.head_count_kv": cfg.num_kv_heads,
+        "llama.attention.layer_norm_rms_epsilon": cfg.rms_norm_eps,
+        "llama.context_length": cfg.max_context,
+        "llama.rope.freq_base": cfg.rope_theta,
+        "llama.vocab_size": cfg.vocab_size,
+    }
+    path = tmp_path / "tiny.gguf"
+    gguf_mod.write_gguf(path, meta, tensors)
+
+    gguf_params, gguf_cfg = W.params_from_gguf(str(path), cfg)
+    hf_params = W.params_from_hf_state_dict(hf.state_dict(), cfg)
+
+    def flatten(d, prefix=""):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                yield from flatten(v, prefix + k + "/")
+            else:
+                yield prefix + k, v
+
+    hf_flat = dict(flatten(hf_params))
+    for name, arr in flatten(gguf_params):
+        np.testing.assert_array_equal(arr, hf_flat[name], err_msg=name)
+
+    tokens = _tokens(cfg, seq=12, seed=9)
+    np.testing.assert_allclose(
+        np.asarray(M.forward_full(gguf_params, cfg, tokens)),
+        _hf_logits(hf, tokens),
+        atol=ATOL,
+        rtol=RTOL,
+    )
+
+
+def test_config_from_gguf_metadata():
+    from aios_tpu.engine.config import from_gguf_metadata
+
+    md = {
+        "general.architecture": "llama",
+        "general.name": "TinyLlama 1.1B",
+        "llama.block_count": 22,
+        "llama.embedding_length": 2048,
+        "llama.feed_forward_length": 5632,
+        "llama.attention.head_count": 32,
+        "llama.attention.head_count_kv": 4,
+        "llama.context_length": 2048,
+        "llama.vocab_size": 32000,
+    }
+    cfg = from_gguf_metadata(md)
+    assert cfg.num_layers == 22
+    assert cfg.num_kv_heads == 4
+    assert cfg.head_dim == 64
+    assert cfg.vocab_size == 32000
+
+
+def test_preset_param_counts_sane():
+    from aios_tpu.engine.config import MISTRAL_7B, TINYLLAMA_1_1B
+
+    assert 1.0e9 < TINYLLAMA_1_1B.num_params() < 1.2e9
+    assert 7.0e9 < MISTRAL_7B.num_params() < 7.5e9
