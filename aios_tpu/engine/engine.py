@@ -1,0 +1,229 @@
+"""The TPU decode engine: slot KV cache, bucketed prefill, batched decode.
+
+This is the component that replaces llama.cpp end-to-end (SURVEY.md
+section 2.3, "TPU equivalence requirement"): weights live in HBM, prefill and
+the single-token decode step are jitted graphs with static shapes, sampling
+happens on device, and the KV caches are donated so XLA updates them in place.
+
+Shape discipline (the TPU contract):
+  * decode is ONE graph for the lifetime of the engine: [S] tokens ->
+    [S] tokens, S = num_slots. Continuous batching inserts/retires requests
+    by mutating slot state, never by changing shapes.
+  * prefill is compiled per power-of-two length bucket, so an arbitrary
+    prompt costs at most 2x its length and never recompiles after warmup.
+
+A slot lifecycle: prefill(slot, prompt) writes K/V rows [0, len) and samples
+the first token -> repeated step() calls extend the slot by one row each ->
+release(slot). Inactive slots keep decoding garbage (their rows are ignored);
+that is the price of a fixed-shape graph and it is what keeps XLA fast.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import model, sampling
+from .config import ModelConfig
+
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+class TPUEngine:
+    """Single-model decode engine over a fixed set of batch slots."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        *,
+        num_slots: int = 8,
+        max_context: Optional[int] = None,
+        cache_dtype=jnp.bfloat16,
+        seed: int = 0,
+        shardings=None,  # optional ShardingPlan (aios_tpu.engine.sharding)
+    ) -> None:
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_context = int(max_context or cfg.max_context)
+        self.buckets = tuple(
+            b for b in DEFAULT_BUCKETS if b <= self.max_context
+        ) or (self.max_context,)
+        self._lock = threading.Lock()
+        self.plan = shardings
+
+        if shardings is not None:
+            self.params = shardings.put_params(params)
+        else:
+            self.params = jax.tree.map(jnp.asarray, params)
+
+        k, v = model.init_kv_cache(cfg, num_slots, self.max_context, cache_dtype)
+        if shardings is not None:
+            k, v = shardings.put_cache(k), shardings.put_cache(v)
+        self.k_cache, self.v_cache = k, v
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+
+        # host-side per-slot state (scheduler-facing)
+        self.active = np.zeros(num_slots, dtype=bool)
+        self.temps = np.zeros(num_slots, dtype=np.float32)
+        self.top_ps = np.ones(num_slots, dtype=np.float32)
+        self.last_tokens = np.zeros(num_slots, dtype=np.int32)
+
+        self.key = jax.random.PRNGKey(seed)
+
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._prefill_fns: Dict[int, object] = {}
+        self.decode_steps = 0
+
+    # -- jitted cores -------------------------------------------------------
+
+    def _decode_impl(self, params, k_cache, v_cache, tokens, lengths, temps, top_ps, key):
+        logits, k_cache, v_cache = model.decode_step(
+            params, self.cfg, tokens, lengths, k_cache, v_cache
+        )
+        next_tokens = sampling.sample(logits, key, temps, top_ps)
+        return next_tokens, logits, k_cache, v_cache
+
+    def _prefill_impl(self, params, k_cache, v_cache, tokens, slot, true_len, temp, top_p, key):
+        logits, ks, vs = model.prefill(params, self.cfg, tokens)
+        # ks: [L, 1, T, KH, D] -> insert as rows [0, T) of the slot
+        start = (0, slot, 0, 0, 0)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype), start)
+        v_cache = jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype), start)
+        last = logits[0, true_len - 1][None, :]  # [1, V]
+        first_token = sampling.sample(last, key, temp[None], top_p[None])[0]
+        return first_token, k_cache, v_cache
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    # -- public API ---------------------------------------------------------
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.buckets[-1]
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.num_slots) if not self.active[i]]
+
+    def prefill(
+        self,
+        slot: int,
+        token_ids: List[int],
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+    ) -> int:
+        """Fill ``slot`` with a prompt; returns the first generated token."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        token_ids = list(token_ids)[-(self.max_context - 1) :]
+        true_len = len(token_ids)
+        if true_len == 0:
+            raise ValueError("empty prompt")
+        bucket = self.bucket_for(true_len)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, :true_len] = token_ids
+
+        with self._lock:
+            self.key, sub = jax.random.split(self.key)
+            first, self.k_cache, self.v_cache = self._prefill_fn(bucket)(
+                self.params,
+                self.k_cache,
+                self.v_cache,
+                jnp.asarray(padded),
+                jnp.int32(slot),
+                jnp.int32(true_len),
+                jnp.float32(temperature),
+                jnp.float32(top_p),
+                sub,
+            )
+            self.lengths = self.lengths.at[slot].set(true_len)
+            self.active[slot] = True
+            self.temps[slot] = temperature
+            self.top_ps[slot] = top_p
+            token = int(first)
+            self.last_tokens[slot] = token
+            return token
+
+    def step(self) -> np.ndarray:
+        """One batched decode step; returns the next token for every slot.
+
+        Only consult entries where ``self.active`` — inactive slots decode
+        garbage by design (fixed shapes).
+        """
+        with self._lock:
+            self.key, sub = jax.random.split(self.key)
+            tokens = jnp.asarray(self.last_tokens)
+            next_tokens, _logits, self.k_cache, self.v_cache = self._decode_fn(
+                self.params,
+                self.k_cache,
+                self.v_cache,
+                tokens,
+                self.lengths,
+                jnp.asarray(self.temps),
+                jnp.asarray(self.top_ps),
+                sub,
+            )
+            # every slot's cache grew one row (inactive rows are garbage);
+            # clamp so long-idle slots never walk past the cache end
+            self.lengths = jnp.minimum(self.lengths + 1, self.max_context - 1)
+            self.decode_steps += 1
+            out = np.asarray(next_tokens)
+            np.copyto(self.last_tokens, out)
+            return out
+
+    def release(self, slot: int) -> None:
+        self.active[slot] = False
+        with self._lock:
+            self.lengths = self.lengths.at[slot].set(0)
+
+    def slot_length(self, slot: int) -> int:
+        return int(self.lengths[slot])
+
+    def warmup(self, prompt_buckets: Optional[Tuple[int, ...]] = None) -> None:
+        """Pre-compile decode + prefill buckets (LoadModel readiness gate —
+        the reference's /health polling equivalent, model_manager.rs:222-263;
+        without this the first Infer would eat 20-40 s of XLA compile)."""
+        for bucket in prompt_buckets or self.buckets:
+            dummy = [1] * min(4, bucket)
+            self.prefill(0, dummy)
+            self.release(0)
+        self.step()
+
+    # -- convenience (tests, single-shot CLI) -------------------------------
+
+    def generate(
+        self,
+        token_ids: List[int],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        stop_tokens: Tuple[int, ...] = (),
+        slot: int = 0,
+    ) -> List[int]:
+        """Single-request generation loop (the continuous-batching scheduler
+        in engine/batching.py is the production path)."""
+        first = self.prefill(slot, token_ids, temperature, top_p)
+        out = [first]
+        if first in stop_tokens:
+            self.release(slot)
+            return out
+        for _ in range(max_new_tokens - 1):
+            if self.slot_length(slot) >= self.max_context - 1:
+                break
+            tok = int(self.step()[slot])
+            out.append(tok)
+            if tok in stop_tokens:
+                break
+        self.release(slot)
+        return out

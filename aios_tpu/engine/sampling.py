@@ -1,0 +1,60 @@
+"""On-device token sampling: temperature, top-k, top-p, greedy.
+
+Runs inside the jitted decode step (no host round-trip per token), vectorized
+over slots with *per-slot* sampling parameters — different agents' requests in
+the same continuous batch can use different temperatures (the reference's
+per-request `temperature` field, runtime.proto InferRequest).
+
+Replaces llama-server's sampler chain for the parameters the reference
+actually exposes (temperature; plus top-k/top-p which llama-server applies
+with its defaults — inference.rs:103-112 sends temperature only).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+GREEDY_EPS = 1e-4  # temperatures below this mean argmax
+
+
+def top_p_filter(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Mask logits outside the nucleus. logits [B, V], top_p [B] in (0, 1]."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens while the cumulative mass *before* them is < top_p
+    keep_sorted = (cumulative - sorted_probs) < top_p[:, None]
+    # threshold = smallest logit still kept
+    kept_logits = jnp.where(keep_sorted, sorted_logits, jnp.inf)
+    threshold = jnp.min(kept_logits, axis=-1, keepdims=True)
+    return jnp.where(logits >= threshold, logits, -jnp.inf)
+
+
+def top_k_filter(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Mask logits below the k-th largest. top_k [B] int32 (0 = disabled)."""
+    V = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    k = jnp.where(top_k <= 0, V, jnp.minimum(top_k, V))
+    threshold = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    return jnp.where(logits >= threshold, logits, -jnp.inf)
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] fp32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B], 1.0 disables
+    top_k: jnp.ndarray | None = None,  # [B] int32, 0 disables
+) -> jnp.ndarray:
+    """Sample one token per row; temperature < GREEDY_EPS rows take argmax."""
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, GREEDY_EPS)[:, None]
+    scaled = logits / temp
+    if top_k is not None:
+        scaled = top_k_filter(scaled, top_k)
+    scaled = top_p_filter(scaled, top_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+
+    return jnp.where(temperature < GREEDY_EPS, greedy, sampled).astype(jnp.int32)

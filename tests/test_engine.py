@@ -1,0 +1,149 @@
+"""Decode engine: prefill/decode consistency, sampling, slot reuse.
+
+The key invariant (teacher-forcing test): running prefill + step-by-step
+decode through the slot cache must produce exactly the tokens that greedy
+argmax over the full-sequence forward produces — i.e. the incremental KV path
+is numerically identical to the non-cached path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu.engine import model as M
+from aios_tpu.engine import sampling
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return TPUEngine(TINY_TEST, params, num_slots=4, max_context=128, cache_dtype=jnp.float32)
+
+
+def _full_greedy(params, cfg, prompt, n):
+    """Reference: greedy generation via repeated full forward (no cache)."""
+    toks = list(prompt)
+    for _ in range(n):
+        logits = M.forward_full(params, cfg, np.asarray([toks], np.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt) :]
+
+
+def test_greedy_decode_matches_uncached_forward(tiny_engine):
+    prompt = [3, 17, 91, 4, 55, 8]
+    want = _full_greedy(tiny_engine.params, TINY_TEST, prompt, 10)
+    got = tiny_engine.generate(prompt, max_new_tokens=10, temperature=0.0)
+    assert got == want
+
+
+def test_generate_respects_stop_tokens(tiny_engine):
+    prompt = [3, 17, 91, 4, 55, 8]
+    free_run = tiny_engine.generate(prompt, max_new_tokens=10, temperature=0.0)
+    stopper = free_run[3]
+    stopped = tiny_engine.generate(
+        prompt, max_new_tokens=10, temperature=0.0, stop_tokens=(stopper,)
+    )
+    assert stopped == free_run[: free_run.index(stopper) + 1]
+
+
+def test_concurrent_slots_are_independent(tiny_engine):
+    """Two different prompts decoding in adjacent slots must produce the same
+    tokens as each decoding alone (no cross-slot leakage)."""
+    p1 = [5, 9, 2, 41]
+    p2 = [88, 13, 60, 7, 19]
+    solo1 = tiny_engine.generate(p1, max_new_tokens=6)
+    solo2 = tiny_engine.generate(p2, max_new_tokens=6)
+
+    t1 = tiny_engine.prefill(1, p1, temperature=0.0)
+    t2 = tiny_engine.prefill(2, p2, temperature=0.0)
+    got1, got2 = [t1], [t2]
+    for _ in range(5):
+        toks = tiny_engine.step()
+        got1.append(int(toks[1]))
+        got2.append(int(toks[2]))
+    tiny_engine.release(1)
+    tiny_engine.release(2)
+    assert got1 == solo1
+    assert got2 == solo2
+
+
+def test_slot_reuse_after_release(tiny_engine):
+    p = [42, 42, 7]
+    a = tiny_engine.generate(p, max_new_tokens=5, slot=3)
+    b = tiny_engine.generate(p, max_new_tokens=5, slot=3)
+    assert a == b
+
+
+def test_prompt_bucketing_invariant(tiny_engine):
+    """The same prompt must decode identically whatever bucket it lands in
+    (padding rows must not leak into attention)."""
+    prompt = [9] * 15  # bucket 16
+    short = tiny_engine.generate(prompt, max_new_tokens=4)
+    prompt_long = [1] * 17 + [9] * 15  # bucket 32; different prefix
+    # invariance check: run 15-token prompt again, engine state unchanged
+    again = tiny_engine.generate(prompt, max_new_tokens=4)
+    assert short == again
+    assert len(tiny_engine.generate(prompt_long, max_new_tokens=4)) == 4
+
+
+# ---------------------------------------------------------------------------
+# Sampling
+# ---------------------------------------------------------------------------
+
+
+def test_top_p_filter_masks_tail():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    out = sampling.top_p_filter(logits, jnp.asarray([0.7]))
+    # 0.5 kept (cum before = 0); 0.3 kept (cum before = 0.5 < 0.7);
+    # 0.15 dropped (cum before = 0.8 >= 0.7)
+    assert np.isfinite(np.asarray(out[0, :2])).all()
+    assert np.isneginf(np.asarray(out[0, 2:])).all()
+
+
+def test_top_k_filter():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    out = sampling.top_k_filter(logits, jnp.asarray([2]))
+    assert np.isneginf(np.asarray(out[0, [0, 3]])).all()
+    assert np.isfinite(np.asarray(out[0, [1, 2]])).all()
+
+
+def test_sample_greedy_vs_stochastic_rows():
+    logits = jnp.asarray([[0.0, 10.0, 0.0], [0.0, 10.0, 0.0]])
+    toks = sampling.sample(
+        logits,
+        jax.random.PRNGKey(0),
+        temperature=jnp.asarray([0.0, 1.0]),
+        top_p=jnp.asarray([1.0, 1.0]),
+    )
+    assert int(toks[0]) == 1  # greedy row
+    assert 0 <= int(toks[1]) < 3
+
+
+def test_sampling_distribution_statistics():
+    """Temperature-1 sampling over a known distribution approximates it."""
+    probs = np.asarray([0.6, 0.3, 0.1])
+    logits = jnp.broadcast_to(jnp.log(jnp.asarray(probs)), (2000, 3))
+    toks = sampling.sample(
+        logits,
+        jax.random.PRNGKey(1),
+        temperature=jnp.ones(2000),
+        top_p=jnp.ones(2000),
+    )
+    counts = np.bincount(np.asarray(toks), minlength=3) / 2000
+    np.testing.assert_allclose(counts, probs, atol=0.05)
+
+
+def test_top_p_excludes_tail_statistically():
+    probs = np.asarray([0.55, 0.35, 0.1])
+    logits = jnp.broadcast_to(jnp.log(jnp.asarray(probs)), (500, 3))
+    toks = sampling.sample(
+        logits,
+        jax.random.PRNGKey(2),
+        temperature=jnp.ones(500),
+        top_p=jnp.full(500, 0.6),
+    )
+    # nucleus at 0.6 keeps tokens 0 and 1 only
+    assert set(np.asarray(toks).tolist()) <= {0, 1}
