@@ -143,13 +143,15 @@ def _mlp(x, lp, cfg: ModelConfig):
 
 
 def forward_full(
-    params: Params, cfg: ModelConfig, tokens: jnp.ndarray
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, attn_fn=None
 ) -> jnp.ndarray:
     """Full-sequence causal forward; logits [B, T, V] in fp32.
 
     Used for training, numeric-parity testing and as the prefill core.
+    ``attn_fn`` swaps the attention implementation (e.g. ring attention for
+    sequence-parallel training); it defaults to in-core GQA attention.
     """
-    logits, _, _ = _forward_with_kv(params, cfg, tokens)
+    logits, _, _ = _forward_with_kv(params, cfg, tokens, attn_fn)
     return logits
 
 
@@ -163,16 +165,17 @@ def prefill(
     return _forward_with_kv(params, cfg, tokens)
 
 
-def _forward_with_kv(params, cfg: ModelConfig, tokens):
+def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None):
     B, T = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     mask = causal_mask(T, cfg.sliding_window)
+    attention = attn_fn or gqa_attention
 
     def block(x, lp):
         q, k, v = _project_qkv(x, lp, cfg, cos, sin)
-        attn = gqa_attention(q, k, v, mask)
+        attn = attention(q, k, v, mask)
         x = x + attn.reshape(B, T, -1) @ lp["wo"]
         x = x + _mlp(x, lp, cfg)
         return x, (k, v)

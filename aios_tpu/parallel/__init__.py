@@ -1,0 +1,6 @@
+"""Parallelism: device meshes, TP/DP/SP sharding plans, ring attention.
+
+The reference has no ML parallelism at all (SURVEY.md section 2.4 — each
+model is one llama-server process); this package is where the TPU build
+scales instead: jax.sharding meshes with XLA collectives over ICI/DCN.
+"""

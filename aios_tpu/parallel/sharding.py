@@ -1,0 +1,135 @@
+"""Mesh construction and parameter/cache sharding plans.
+
+Megatron-style tensor parallelism expressed as GSPMD annotations: we place
+NamedShardings on params and KV caches, and XLA inserts the ICI collectives
+(all-reduce after row-parallel matmuls, all-gather for the vocab-sharded
+embedding) — no hand-written collective calls on the decode path, per the
+scaling-book recipe: pick a mesh, annotate, let XLA do the rest.
+
+Axes:
+  dp — data/replica axis: batch slots in decode, batch in training
+  sp — sequence axis: ring-attention sequence parallelism (long context)
+  tp — model axis: attention heads + FFN hidden sharded across chips
+
+Equivalent role in the reference: none (single-process llama.cpp); this is
+the "Mistral-7B tensor-parallel decode across 4 chips (ICI all-reduce)"
+benchmark config of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.config import ModelConfig
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    dp: int = 1,
+    sp: int = 1,
+    tp: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (dp, sp, tp) mesh. Unspecified tp absorbs remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if tp is None:
+        assert n % (dp * sp) == 0, (n, dp, sp)
+        tp = n // (dp * sp)
+    assert dp * sp * tp == n, f"mesh {dp}x{sp}x{tp} != {n} devices"
+    arr = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+
+
+# Partition rules for the engine params pytree (path suffix -> spec).
+# Column-parallel projections shard the output dim on tp; row-parallel ones
+# shard the input dim, and GSPMD inserts the psum on their outputs.
+PARAM_RULES: Dict[str, P] = {
+    "embed": P("tp", None),  # vocab-sharded
+    "layers/attn_norm": P(None, None),
+    "layers/ffn_norm": P(None, None),
+    "layers/q_norm": P(None, None),
+    "layers/k_norm": P(None, None),
+    "layers/wq": P(None, None, "tp"),
+    "layers/wk": P(None, None, "tp"),
+    "layers/wv": P(None, None, "tp"),
+    "layers/wo": P(None, "tp", None),
+    "layers/w_gate": P(None, None, "tp"),
+    "layers/w_up": P(None, None, "tp"),
+    "layers/w_down": P(None, "tp", None),
+    "final_norm": P(None),
+    "lm_head": P(None, "tp"),
+}
+
+# KV cache [L, slots, C, KH, D]: slots over dp, kv heads over tp.
+CACHE_SPEC = P(None, "dp", None, "tp", None)
+
+
+@dataclass
+class ShardingPlan:
+    """Placement helper handed to TPUEngine / the trainer."""
+
+    mesh: Mesh
+
+    def spec_for(self, path: str) -> P:
+        if path in PARAM_RULES:
+            return PARAM_RULES[path]
+        raise KeyError(f"no partition rule for param {path!r}")
+
+    def params_shardings(self, params) -> Dict:
+        def walk(tree, prefix=""):
+            out = {}
+            for k, v in tree.items():
+                path = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    out[k] = walk(v, path + "/")
+                else:
+                    out[k] = NamedSharding(self.mesh, self.spec_for(path))
+            return out
+
+        return walk(params)
+
+    def put_params(self, params):
+        shardings = self.params_shardings(params)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(jax.numpy.asarray(x), s), params, shardings
+        )
+
+    def put_cache(self, cache):
+        return jax.device_put(cache, NamedSharding(self.mesh, CACHE_SPEC))
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape["tp"]
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape["dp"]
+
+    @property
+    def sp(self) -> int:
+        return self.mesh.shape["sp"]
+
+    def validate(self, cfg: ModelConfig, num_slots: int) -> None:
+        tp, dp = self.tp, self.dp
+        assert cfg.num_kv_heads % tp == 0, (
+            f"kv heads {cfg.num_kv_heads} not divisible by tp={tp}"
+        )
+        assert cfg.num_heads % tp == 0
+        assert cfg.intermediate_size % tp == 0
+        assert num_slots % dp == 0, f"slots {num_slots} not divisible by dp={dp}"
+
+
+def single_device_plan() -> Optional[ShardingPlan]:
+    """None when there is nothing to shard (1 device)."""
+    if len(jax.devices()) == 1:
+        return None
+    return ShardingPlan(build_mesh())
