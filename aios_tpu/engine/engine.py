@@ -2,20 +2,24 @@
 
 This is the component that replaces llama.cpp end-to-end (SURVEY.md
 section 2.3, "TPU equivalence requirement"): weights live in HBM, prefill and
-the single-token decode step are jitted graphs with static shapes, sampling
-happens on device, and the KV caches are donated so XLA updates them in place.
+the decode loop are jitted graphs with static shapes, sampling happens on
+device, and ALL decode state (KV caches, slot lengths, last tokens, per-slot
+sampling params, RNG key) is device-resident and donated — a decode dispatch
+moves no state across the host boundary except the sampled tokens coming out.
 
 Shape discipline (the TPU contract):
-  * decode is ONE graph for the lifetime of the engine: [S] tokens ->
-    [S] tokens, S = num_slots. Continuous batching inserts/retires requests
-    by mutating slot state, never by changing shapes.
+  * decode is ONE graph for the lifetime of the engine: `step_n` runs K
+    decode steps under `lax.scan` per dispatch ([S] -> [K, S] tokens), so
+    host/relay round-trip latency amortizes over K tokens. Continuous
+    batching inserts/retires requests by mutating slot state, never by
+    changing shapes.
   * prefill is compiled per power-of-two length bucket, so an arbitrary
     prompt costs at most 2x its length and never recompiles after warmup.
 
 A slot lifecycle: prefill(slot, prompt) writes K/V rows [0, len) and samples
-the first token -> repeated step() calls extend the slot by one row each ->
-release(slot). Inactive slots keep decoding garbage (their rows are ignored);
-that is the price of a fixed-shape graph and it is what keeps XLA fast.
+the first token -> step_n() extends every active slot -> release(slot).
+Inactive slots keep decoding garbage (their outputs are ignored); that is the
+price of a fixed-shape graph and it is what keeps XLA fast.
 """
 
 from __future__ import annotations
@@ -32,6 +36,10 @@ from .config import ModelConfig
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
+# Device-resident decode state, threaded through the jitted cores as one
+# donated pytree: {k, v, lengths, last_tokens, temps, top_ps, key}
+DecodeState = Dict[str, jnp.ndarray]
+
 
 class TPUEngine:
     """Single-model decode engine over a fixed set of batch slots."""
@@ -45,7 +53,7 @@ class TPUEngine:
         max_context: Optional[int] = None,
         cache_dtype=jnp.bfloat16,
         seed: int = 0,
-        shardings=None,  # optional ShardingPlan (aios_tpu.engine.sharding)
+        shardings=None,  # optional ShardingPlan (aios_tpu.parallel.sharding)
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -64,44 +72,85 @@ class TPUEngine:
         k, v = model.init_kv_cache(cfg, num_slots, self.max_context, cache_dtype)
         if shardings is not None:
             k, v = shardings.put_cache(k), shardings.put_cache(v)
-        self.k_cache, self.v_cache = k, v
-        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        self.state: DecodeState = {
+            "k": k,
+            "v": v,
+            "lengths": jnp.zeros((num_slots,), jnp.int32),
+            "last_tokens": jnp.zeros((num_slots,), jnp.int32),
+            "temps": jnp.zeros((num_slots,), jnp.float32),
+            "top_ps": jnp.ones((num_slots,), jnp.float32),
+            "key": jax.random.PRNGKey(seed),
+        }
 
-        # host-side per-slot state (scheduler-facing)
+        # host-side mirror for the scheduler
         self.active = np.zeros(num_slots, dtype=bool)
-        self.temps = np.zeros(num_slots, dtype=np.float32)
-        self.top_ps = np.ones(num_slots, dtype=np.float32)
-        self.last_tokens = np.zeros(num_slots, dtype=np.int32)
+        self._host_lengths = np.zeros(num_slots, dtype=np.int64)
 
-        self.key = jax.random.PRNGKey(seed)
-
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._step_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[int, object] = {}
         self.decode_steps = 0
 
     # -- jitted cores -------------------------------------------------------
 
-    def _decode_impl(self, params, k_cache, v_cache, tokens, lengths, temps, top_ps, key):
-        logits, k_cache, v_cache = model.decode_step(
-            params, self.cfg, tokens, lengths, k_cache, v_cache
-        )
-        next_tokens = sampling.sample(logits, key, temps, top_ps)
-        return next_tokens, logits, k_cache, v_cache
+    def _step_impl(self, params, state: DecodeState, n_steps: int):
+        def one(carry, _):
+            st = carry
+            key, sub = jax.random.split(st["key"])
+            logits, k, v = model.decode_step(
+                params, self.cfg, st["last_tokens"], st["lengths"], st["k"], st["v"]
+            )
+            next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
+            st = {
+                "k": k,
+                "v": v,
+                "lengths": jnp.minimum(st["lengths"] + 1, self.max_context - 1),
+                "last_tokens": next_tokens,
+                "temps": st["temps"],
+                "top_ps": st["top_ps"],
+                "key": key,
+            }
+            return st, next_tokens
 
-    def _prefill_impl(self, params, k_cache, v_cache, tokens, slot, true_len, temp, top_p, key):
+        state, tokens = jax.lax.scan(one, state, None, length=n_steps)
+        return state, tokens  # tokens [n_steps, S]
+
+    def _prefill_impl(
+        self, params, state: DecodeState, tokens, slot, true_len, temp, top_p
+    ):
         logits, ks, vs = model.prefill(params, self.cfg, tokens)
-        # ks: [L, 1, T, KH, D] -> insert as rows [0, T) of the slot
         start = (0, slot, 0, 0, 0)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype), start)
-        v_cache = jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype), start)
+        k = jax.lax.dynamic_update_slice(
+            state["k"], ks.astype(state["k"].dtype), start
+        )
+        v = jax.lax.dynamic_update_slice(
+            state["v"], vs.astype(state["v"].dtype), start
+        )
+        key, sub = jax.random.split(state["key"])
         last = logits[0, true_len - 1][None, :]  # [1, V]
-        first_token = sampling.sample(last, key, temp[None], top_p[None])[0]
-        return first_token, k_cache, v_cache
+        first = sampling.sample(last, sub, temp[None], top_p[None])[0]
+        return {
+            "k": k,
+            "v": v,
+            "lengths": state["lengths"].at[slot].set(true_len),
+            "last_tokens": state["last_tokens"].at[slot].set(first),
+            "temps": state["temps"].at[slot].set(temp),
+            "top_ps": state["top_ps"].at[slot].set(top_p),
+            "key": key,
+        }, first
+
+    def _step_fn(self, n_steps: int):
+        fn = self._step_fns.get(n_steps)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, s: self._step_impl(p, s, n_steps), donate_argnums=(1,)
+            )
+            self._step_fns[n_steps] = fn
+        return fn
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+            fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -135,70 +184,52 @@ class TPUEngine:
         padded[0, :true_len] = token_ids
 
         with self._lock:
-            self.key, sub = jax.random.split(self.key)
-            first, self.k_cache, self.v_cache = self._prefill_fn(bucket)(
+            self.state, first = self._prefill_fn(bucket)(
                 self.params,
-                self.k_cache,
-                self.v_cache,
+                self.state,
                 jnp.asarray(padded),
                 jnp.int32(slot),
                 jnp.int32(true_len),
                 jnp.float32(temperature),
                 jnp.float32(top_p),
-                sub,
             )
-            self.lengths = self.lengths.at[slot].set(true_len)
             self.active[slot] = True
-            self.temps[slot] = temperature
-            self.top_ps[slot] = top_p
-            token = int(first)
-            self.last_tokens[slot] = token
-            return token
+            self._host_lengths[slot] = true_len
+            return int(first)
 
-    def step(self) -> np.ndarray:
-        """One batched decode step; returns the next token for every slot.
+    def step(self, n_steps: int = 1) -> np.ndarray:
+        """Run ``n_steps`` batched decode steps in one dispatch.
 
-        Only consult entries where ``self.active`` — inactive slots decode
-        garbage by design (fixed shapes).
+        Returns tokens [n_steps, num_slots]; only columns where
+        ``self.active`` are meaningful. Lengths advance for every slot
+        (fixed-shape graph), clamped at the cache end.
         """
         with self._lock:
-            self.key, sub = jax.random.split(self.key)
-            tokens = jnp.asarray(self.last_tokens)
-            next_tokens, _logits, self.k_cache, self.v_cache = self._decode_fn(
-                self.params,
-                self.k_cache,
-                self.v_cache,
-                tokens,
-                self.lengths,
-                jnp.asarray(self.temps),
-                jnp.asarray(self.top_ps),
-                sub,
+            self.state, tokens = self._step_fn(n_steps)(self.params, self.state)
+            self.decode_steps += n_steps
+            self._host_lengths = np.minimum(
+                self._host_lengths + n_steps, self.max_context - 1
             )
-            # every slot's cache grew one row (inactive rows are garbage);
-            # clamp so long-idle slots never walk past the cache end
-            self.lengths = jnp.minimum(self.lengths + 1, self.max_context - 1)
-            self.decode_steps += 1
-            out = np.asarray(next_tokens)
-            np.copyto(self.last_tokens, out)
-            return out
+            return np.asarray(tokens)
 
     def release(self, slot: int) -> None:
         self.active[slot] = False
+        self._host_lengths[slot] = 0
         with self._lock:
-            self.lengths = self.lengths.at[slot].set(0)
+            self.state["lengths"] = self.state["lengths"].at[slot].set(0)
 
     def slot_length(self, slot: int) -> int:
-        return int(self.lengths[slot])
+        return int(self._host_lengths[slot])
 
-    def warmup(self, prompt_buckets: Optional[Tuple[int, ...]] = None) -> None:
+    def warmup(self, step_sizes: Tuple[int, ...] = (1, 8)) -> None:
         """Pre-compile decode + prefill buckets (LoadModel readiness gate —
         the reference's /health polling equivalent, model_manager.rs:222-263;
         without this the first Infer would eat 20-40 s of XLA compile)."""
-        for bucket in prompt_buckets or self.buckets:
-            dummy = [1] * min(4, bucket)
-            self.prefill(0, dummy)
+        for bucket in self.buckets:
+            self.prefill(0, [1] * min(4, bucket))
             self.release(0)
-        self.step()
+        for n in step_sizes:
+            self.step(n)
 
     # -- convenience (tests, single-shot CLI) -------------------------------
 
@@ -210,20 +241,25 @@ class TPUEngine:
         top_p: float = 1.0,
         stop_tokens: Tuple[int, ...] = (),
         slot: int = 0,
+        chunk: int = 8,
     ) -> List[int]:
         """Single-request generation loop (the continuous-batching scheduler
         in engine/batching.py is the production path)."""
         first = self.prefill(slot, token_ids, temperature, top_p)
         out = [first]
-        if first in stop_tokens:
-            self.release(slot)
-            return out
-        for _ in range(max_new_tokens - 1):
-            if self.slot_length(slot) >= self.max_context - 1:
+        while len(out) < max_new_tokens and out[-1] not in stop_tokens:
+            budget = min(chunk, max_new_tokens - len(out))
+            room = self.max_context - 1 - self.slot_length(slot)
+            if room <= 0:
                 break
-            tok = int(self.step()[slot])
-            out.append(tok)
-            if tok in stop_tokens:
-                break
+            toks = self.step(min(budget, room))[:, slot]
+            for t in toks.tolist():
+                out.append(int(t))
+                if t in stop_tokens:
+                    break
         self.release(slot)
+        if stop_tokens:
+            for i, t in enumerate(out):
+                if t in stop_tokens:
+                    return out[: i + 1]
         return out

@@ -60,10 +60,9 @@ def test_concurrent_slots_are_independent(tiny_engine):
     t1 = tiny_engine.prefill(1, p1, temperature=0.0)
     t2 = tiny_engine.prefill(2, p2, temperature=0.0)
     got1, got2 = [t1], [t2]
-    for _ in range(5):
-        toks = tiny_engine.step()
-        got1.append(int(toks[1]))
-        got2.append(int(toks[2]))
+    toks = tiny_engine.step(5)  # [5, S] — one dispatch, five tokens per slot
+    got1.extend(int(t) for t in toks[:, 1])
+    got2.extend(int(t) for t in toks[:, 2])
     tiny_engine.release(1)
     tiny_engine.release(2)
     assert got1 == solo1
