@@ -1,0 +1,189 @@
+"""Continuous batching: many concurrent requests over one decode graph.
+
+The reference serializes requests per model into llama-server's HTTP queue
+and caps concurrent AI work at 3 (autonomy.rs Semaphore(3), SURVEY.md
+section 2.4); here the 8+ agents' requests land in ONE batched decode step —
+the scheduler assigns each request a cache slot, prefills it, and every
+decode dispatch advances all active slots together. Tokens stream to each
+caller through a per-request queue as dispatches complete.
+
+Scheduling policy (single background thread, dispatch-level granularity):
+  * admit waiting requests whenever slots are free (prefill immediately);
+  * decode in chunks of `chunk_steps` tokens per dispatch (amortizes
+    host<->device round trips); a smaller chunk is used when requests are
+    waiting so admission latency stays low;
+  * requests retire on EOS/stop token, max_tokens, or a full cache slot.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .engine import TPUEngine
+
+_END = object()
+
+
+@dataclass
+class Request:
+    prompt_ids: List[int]
+    max_tokens: int = 256
+    temperature: float = 0.7
+    top_p: float = 0.95
+    stop_ids: Tuple[int, ...] = ()
+    request_id: str = ""
+
+
+@dataclass
+class _Live:
+    req: Request
+    slot: int
+    produced: int = 0
+    out_q: "queue.Queue" = field(default_factory=queue.Queue)
+    first_token_at: float = 0.0
+    submitted_at: float = 0.0
+    done: bool = False
+
+
+class RequestHandle:
+    """Caller-side view of an in-flight request (blocking token iterator)."""
+
+    def __init__(self, live: _Live):
+        self._live = live
+
+    def __iter__(self):
+        while True:
+            item = self._live.out_q.get()
+            if item is _END:
+                return
+            yield item
+
+    def tokens(self) -> List[int]:
+        return list(self)
+
+    @property
+    def ttft_ms(self) -> float:
+        if not self._live.first_token_at:
+            return 0.0
+        return (self._live.first_token_at - self._live.submitted_at) * 1000.0
+
+
+class ContinuousBatcher:
+    """Background scheduler marrying a request queue to engine slots."""
+
+    def __init__(
+        self,
+        engine: TPUEngine,
+        chunk_steps: int = 8,
+        admit_chunk_steps: int = 2,
+    ) -> None:
+        self.engine = engine
+        self.chunk_steps = chunk_steps
+        self.admit_chunk_steps = admit_chunk_steps
+        self._waiting: "queue.Queue[_Live]" = queue.Queue()
+        self._live: Dict[int, _Live] = {}  # slot -> request
+        self._wake = threading.Event()
+        self._stop = False
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self.completed = 0
+        self._thread = threading.Thread(
+            target=self._run, name="continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, req: Request) -> RequestHandle:
+        if not req.request_id:
+            req.request_id = f"req-{next(self._ids)}"
+        live = _Live(req=req, slot=-1, submitted_at=time.monotonic())
+        self._waiting.put(live)
+        self._wake.set()
+        return RequestHandle(live)
+
+    def generate(self, prompt_ids: Sequence[int], **kw) -> List[int]:
+        return self.submit(Request(prompt_ids=list(prompt_ids), **kw)).tokens()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    # -- scheduler loop -----------------------------------------------------
+
+    def _admit(self) -> None:
+        while True:
+            free = self.engine.free_slots()
+            if not free:
+                return
+            try:
+                live = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            slot = free[0]
+            live.slot = slot
+            first = self.engine.prefill(
+                slot,
+                live.req.prompt_ids,
+                temperature=live.req.temperature,
+                top_p=live.req.top_p,
+            )
+            live.first_token_at = time.monotonic()
+            with self._lock:
+                self._live[slot] = live
+            self._emit(live, first)
+
+    def _emit(self, live: _Live, token: int) -> None:
+        live.produced += 1
+        live.out_q.put(token)
+        hit_stop = token in live.req.stop_ids
+        out_of_budget = live.produced >= live.req.max_tokens
+        out_of_cache = (
+            self.engine.slot_length(live.slot) >= self.engine.max_context - 1
+        )
+        if hit_stop or out_of_budget or out_of_cache:
+            self._finish(live)
+
+    def _finish(self, live: _Live) -> None:
+        live.done = True
+        with self._lock:
+            self._live.pop(live.slot, None)
+        self.engine.release(live.slot)
+        self.completed += 1
+        # _END goes last: when a consumer unblocks, all scheduler-side state
+        # (slot freed, counters bumped) is already final
+        live.out_q.put(_END)
+
+    def _run(self) -> None:
+        while not self._stop:
+            self._admit()
+            with self._lock:
+                slots = {s: l for s, l in self._live.items()}
+            if not slots:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            # keep admission latency low when someone is waiting
+            n = self.admit_chunk_steps if not self._waiting.empty() else self.chunk_steps
+            max_budget = min(
+                (l.req.max_tokens - l.produced for l in slots.values()),
+                default=n,
+            )
+            n = max(1, min(n, max_budget))
+            tokens = self.engine.step(n)  # [n, num_slots]
+            for step_row in tokens:
+                for slot, live in list(slots.items()):
+                    if live.done:
+                        continue
+                    self._emit(live, int(step_row[slot]))

@@ -1,0 +1,211 @@
+"""Tokenizers: GGUF-embedded SentencePiece-BPE, HF wrapper, byte fallback.
+
+llama-server tokenizes with the vocab embedded in the GGUF file; to replace
+it with zero extra assets we implement the same SentencePiece-style BPE
+(greedy best-score pair merging with byte fallback) directly over the GGUF
+metadata arrays (tokenizer.ggml.tokens/scores/token_type). When a HF model
+directory is available we defer to transformers instead. Chat templating for
+the reference's prompt/system_prompt pair (runtime.proto InferRequest)
+follows each family's native format.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+# token_type values in GGUF (llama.cpp llama_token_type)
+TOKEN_TYPE_NORMAL = 1
+TOKEN_TYPE_UNKNOWN = 2
+TOKEN_TYPE_CONTROL = 3
+TOKEN_TYPE_USER_DEFINED = 4
+TOKEN_TYPE_BYTE = 6
+
+SPIECE_SPACE = "▁"  # ▁
+
+
+class BaseTokenizer:
+    bos_id: Optional[int] = None
+    eos_id: Optional[int] = None
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+
+@dataclass
+class SentencePieceBPE(BaseTokenizer):
+    """SentencePiece-style BPE over a GGUF vocab (llama/mistral models)."""
+
+    tokens: List[str]
+    scores: List[float]
+    token_types: List[int]
+    bos_id: Optional[int] = 1
+    eos_id: Optional[int] = 2
+    add_prefix_space: bool = True
+    _index: Dict[str, int] = field(default_factory=dict, repr=False)
+    _byte_ids: Dict[int, int] = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        self._index = {t: i for i, t in enumerate(self.tokens)}
+        for i, (tok, typ) in enumerate(zip(self.tokens, self.token_types)):
+            if typ == TOKEN_TYPE_BYTE and tok.startswith("<0x") and tok.endswith(">"):
+                self._byte_ids[int(tok[3:-1], 16)] = i
+
+    @classmethod
+    def from_gguf_metadata(cls, md: dict) -> "SentencePieceBPE":
+        tokens = md["tokenizer.ggml.tokens"]
+        n = len(tokens)
+        return cls(
+            tokens=tokens,
+            scores=list(md.get("tokenizer.ggml.scores", [0.0] * n)),
+            token_types=list(md.get("tokenizer.ggml.token_type", [1] * n)),
+            bos_id=int(md.get("tokenizer.ggml.bos_token_id", 1)),
+            eos_id=int(md.get("tokenizer.ggml.eos_token_id", 2)),
+        )
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tokens)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        if self.add_prefix_space and not text.startswith(" "):
+            text = " " + text
+        text = text.replace(" ", SPIECE_SPACE)
+
+        # initial symbols: one per character; unknowns byte-fall-back at the end
+        symbols = list(text)
+
+        def piece_score(s: str) -> Optional[float]:
+            i = self._index.get(s)
+            if i is None:
+                return None
+            return self.scores[i] if i < len(self.scores) else 0.0
+
+        # greedy best-score merge (SentencePiece BPE semantics)
+        while len(symbols) > 1:
+            best_idx, best_score = -1, None
+            for i in range(len(symbols) - 1):
+                merged = symbols[i] + symbols[i + 1]
+                sc = piece_score(merged)
+                if sc is not None and (best_score is None or sc > best_score):
+                    best_idx, best_score = i, sc
+            if best_idx < 0:
+                break
+            symbols[best_idx : best_idx + 2] = [symbols[best_idx] + symbols[best_idx + 1]]
+
+        ids: List[int] = []
+        if add_bos and self.bos_id is not None:
+            ids.append(self.bos_id)
+        for sym in symbols:
+            idx = self._index.get(sym)
+            if idx is not None:
+                ids.append(idx)
+                continue
+            for b in sym.encode("utf-8"):  # byte fallback
+                bid = self._byte_ids.get(b)
+                if bid is not None:
+                    ids.append(bid)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out: List[str] = []
+        byte_run: List[int] = []
+
+        def flush_bytes():
+            if byte_run:
+                out.append(bytes(byte_run).decode("utf-8", errors="replace"))
+                byte_run.clear()
+
+        for i in ids:
+            if not 0 <= i < len(self.tokens):
+                continue
+            typ = self.token_types[i] if i < len(self.token_types) else 1
+            if typ == TOKEN_TYPE_BYTE:
+                tok = self.tokens[i]
+                byte_run.append(int(tok[3:-1], 16))
+                continue
+            flush_bytes()
+            if typ == TOKEN_TYPE_CONTROL:
+                continue
+            out.append(self.tokens[i])
+        flush_bytes()
+        return "".join(out).replace(SPIECE_SPACE, " ").lstrip(" ")
+
+
+class HFTokenizer(BaseTokenizer):
+    """transformers-backed tokenizer for HF model directories."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+class ByteTokenizer(BaseTokenizer):
+    """256-symbol byte tokenizer — synthetic models, benches, smoke tests."""
+
+    bos_id = 256
+    eos_id = 257
+
+    @property
+    def vocab_size(self) -> int:
+        return 258
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+# ---------------------------------------------------------------------------
+# Chat templating (llama-server applied the GGUF chat template; we do the
+# same per model family for the prompt/system_prompt pair)
+# ---------------------------------------------------------------------------
+
+
+def render_chat(
+    family: str, prompt: str, system_prompt: str = ""
+) -> str:
+    """Render a single-turn chat for the given model family."""
+    fam = family.lower()
+    if "tinyllama" in fam or "zephyr" in fam:
+        parts = []
+        if system_prompt:
+            parts.append(f"<|system|>\n{system_prompt}</s>\n")
+        parts.append(f"<|user|>\n{prompt}</s>\n<|assistant|>\n")
+        return "".join(parts)
+    if "mistral" in fam:
+        sys = f"{system_prompt}\n\n" if system_prompt else ""
+        return f"[INST] {sys}{prompt} [/INST]"
+    if "qwen" in fam or "deepseek" in fam or "chatml" in fam:
+        parts = []
+        if system_prompt:
+            parts.append(f"<|im_start|>system\n{system_prompt}<|im_end|>\n")
+        parts.append(f"<|im_start|>user\n{prompt}<|im_end|>\n<|im_start|>assistant\n")
+        return "".join(parts)
+    sys = f"System: {system_prompt}\n\n" if system_prompt else ""
+    return f"{sys}User: {prompt}\n\nAssistant:"
