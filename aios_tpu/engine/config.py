@@ -120,9 +120,10 @@ PRESETS: Dict[str, ModelConfig] = {
 }
 
 # Tiny variants for tests / dry runs (same code paths, trivial sizes).
+# vocab 512 covers the ByteTokenizer's 258 ids (bos=256, eos=257).
 TINY_TEST = ModelConfig(
     name="tiny-test",
-    vocab_size=256,
+    vocab_size=512,
     hidden_size=64,
     intermediate_size=128,
     num_layers=2,

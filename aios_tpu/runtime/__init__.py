@@ -1,0 +1,5 @@
+"""aios.runtime.AIRuntime — the TPU inference service.
+
+Same gRPC surface as the reference's runtime crate (runtime/src/), backed by
+in-process JAX engines instead of llama-server child processes.
+"""

@@ -1,0 +1,284 @@
+"""Model lifecycle + intelligence-level routing for the TPU runtime.
+
+Reference parity (runtime/src/model_manager.rs):
+  * name -> managed model registry with states loading/ready/error/unloading
+    (model_manager.rs:24-29) — here a model is an in-process TPUEngine +
+    ContinuousBatcher + tokenizer, not a llama-server child, so "loading"
+    covers dequantize + device_put + warm-compile and "ready" means the
+    decode graph is compiled (the /health polling of the reference,
+    model_manager.rs:222-263, collapses into warmup()).
+  * startup auto-scan of AIOS_MODEL_DIR for *.gguf with context length
+    chosen by file size (runtime/src/main.rs:65-132).
+  * select_model_for_level routing ladders with partial case-insensitive
+    name matching (model_manager.rs:462-518): reactive -> None;
+    operational -> tinyllama > deepseek > mistral; tactical -> deepseek >
+    qwen3 > mistral > tinyllama; strategic -> qwen3 > deepseek > mistral.
+
+TPU-specific: `synthetic://<preset>` model paths build a random-weight model
+of that architecture (benchmarks and tests run without weight files).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import gguf as gguf_mod
+from ..engine import model as model_mod
+from ..engine import weights as weights_mod
+from ..engine.batching import ContinuousBatcher
+from ..engine.config import PRESETS, ModelConfig, from_gguf_metadata, TINY_TEST
+from ..engine.engine import TPUEngine
+from ..engine.tokenizer import (
+    BaseTokenizer,
+    ByteTokenizer,
+    HFTokenizer,
+    SentencePieceBPE,
+)
+
+log = logging.getLogger("aios.runtime.models")
+
+STATE_LOADING = "loading"
+STATE_READY = "ready"
+STATE_ERROR = "error"
+STATE_UNLOADING = "unloading"
+
+# Routing ladders per intelligence level (model_manager.rs:462-505).
+LEVEL_LADDERS: Dict[str, List[str]] = {
+    "reactive": [],
+    "operational": ["tinyllama", "deepseek", "mistral"],
+    "tactical": ["deepseek", "qwen3", "mistral", "tinyllama"],
+    "strategic": ["qwen3", "deepseek", "mistral"],
+}
+
+
+@dataclass
+class ManagedModel:
+    name: str
+    config: ModelConfig
+    engine: TPUEngine
+    batcher: ContinuousBatcher
+    tokenizer: BaseTokenizer
+    state: str = STATE_LOADING
+    loaded_at: int = 0
+    last_used: int = 0
+    request_count: int = 0
+    error: str = ""
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def touch(self) -> None:
+        self.last_used = int(time.time())
+        self.request_count += 1
+
+
+def _context_for_file_size(n_bytes: int) -> int:
+    """Context length by GGUF file size, as the reference's auto-loader
+    chooses ctx/threads (runtime/src/main.rs:86-98)."""
+    gb = n_bytes / 1e9
+    if gb > 8:
+        return 8192
+    if gb > 2:
+        return 4096
+    return 2048
+
+
+class ModelManager:
+    """Registry of co-resident TPU models sharing the chip's HBM."""
+
+    def __init__(
+        self,
+        num_slots: int = 8,
+        sharding_plan=None,
+        warm_compile: bool = True,
+    ) -> None:
+        self.models: Dict[str, ManagedModel] = {}
+        self.num_slots = num_slots
+        self.plan = sharding_plan
+        self.warm_compile = warm_compile
+        self._lock = threading.Lock()
+
+    # -- loading ------------------------------------------------------------
+
+    def load_model(
+        self,
+        name: str,
+        path: str = "",
+        context_length: int = 0,
+    ) -> ManagedModel:
+        with self._lock:
+            existing = self.models.get(name)
+            if existing is not None and existing.state == STATE_READY:
+                return existing
+
+        t0 = time.time()
+        try:
+            cfg, params, tokenizer = self._load_weights(name, path, context_length)
+            engine = TPUEngine(
+                cfg,
+                params,
+                num_slots=self.num_slots,
+                max_context=context_length or cfg.max_context,
+                shardings=self.plan,
+            )
+            del params
+            if self.warm_compile:
+                engine.warmup()
+            batcher = ContinuousBatcher(engine)
+            managed = ManagedModel(
+                name=name,
+                config=cfg,
+                engine=engine,
+                batcher=batcher,
+                tokenizer=tokenizer,
+                state=STATE_READY,
+                loaded_at=int(time.time()),
+            )
+            with self._lock:
+                self.models[name] = managed
+            log.info(
+                "model %s ready in %.1fs (ctx=%d, %d slots)",
+                name,
+                time.time() - t0,
+                engine.max_context,
+                engine.num_slots,
+            )
+            return managed
+        except Exception as exc:
+            managed = ManagedModel(
+                name=name,
+                config=TINY_TEST,
+                engine=None,  # type: ignore[arg-type]
+                batcher=None,  # type: ignore[arg-type]
+                tokenizer=ByteTokenizer(),
+                state=STATE_ERROR,
+                error=str(exc),
+            )
+            with self._lock:
+                self.models[name] = managed
+            log.error("model %s failed to load: %s", name, exc)
+            raise
+
+    def _load_weights(self, name: str, path: str, context_length: int):
+        """Resolve (config, params, tokenizer) from a model source."""
+        if path.startswith("synthetic://") or not path:
+            preset_name = path.removeprefix("synthetic://") or name
+            cfg = self._resolve_preset(preset_name)
+            params = model_mod.init_params(
+                cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+            )
+            return cfg, params, ByteTokenizer()
+
+        p = Path(path)
+        if p.is_file() and p.suffix == ".gguf":
+            dtype = jnp.bfloat16
+            params, cfg = weights_mod.params_from_gguf(str(p))
+            params = weights_mod.map_params(params, lambda a: a.astype(dtype))
+            f = gguf_mod.GGUFFile(p)
+            tokenizer: BaseTokenizer
+            if "tokenizer.ggml.tokens" in f.metadata:
+                tokenizer = SentencePieceBPE.from_gguf_metadata(f.metadata)
+            else:
+                tokenizer = ByteTokenizer()
+            if context_length:
+                cfg = cfg.scaled(max_context=context_length)
+            return cfg, params, tokenizer
+
+        if p.is_dir():  # HF checkpoint directory
+            import json
+
+            import safetensors.numpy
+
+            with open(p / "config.json") as fh:
+                hf_cfg = json.load(fh)
+            from ..engine.config import from_hf_config
+
+            cfg = from_hf_config(hf_cfg, name=name)
+            sd = {}
+            for st_file in sorted(p.glob("*.safetensors")):
+                sd.update(safetensors.numpy.load_file(st_file))
+            params = weights_mod.params_from_hf_state_dict(sd, cfg)
+            params = weights_mod.map_params(params, lambda a: a.astype(jnp.bfloat16))
+            return cfg, params, HFTokenizer(str(p))
+
+        raise FileNotFoundError(f"model path not found: {path}")
+
+    @staticmethod
+    def _resolve_preset(name: str) -> ModelConfig:
+        low = name.lower()
+        if low in ("tiny-test", "tiny"):
+            return TINY_TEST
+        for key, cfg in PRESETS.items():
+            if low in key or key in low or key.split("-")[0] in low:
+                return cfg
+        raise KeyError(f"no preset matches {name!r}")
+
+    def autoload(self, model_dir: Optional[str] = None) -> List[str]:
+        """Scan AIOS_MODEL_DIR for *.gguf and load each (main.rs:65-132)."""
+        model_dir = model_dir or os.environ.get(
+            "AIOS_MODEL_DIR", "/var/lib/aios/models"
+        )
+        loaded = []
+        d = Path(model_dir)
+        if not d.is_dir():
+            return loaded
+        for f in sorted(d.glob("*.gguf")):
+            name = f.stem.lower()
+            ctx = _context_for_file_size(f.stat().st_size)
+            try:
+                self.load_model(name, str(f), context_length=ctx)
+                loaded.append(name)
+            except Exception:
+                continue
+        return loaded
+
+    # -- unloading ----------------------------------------------------------
+
+    def unload_model(self, name: str) -> bool:
+        with self._lock:
+            managed = self.models.pop(name, None)
+        if managed is None:
+            return False
+        managed.state = STATE_UNLOADING
+        if managed.batcher is not None:
+            managed.batcher.shutdown()
+        # drop engine references; XLA frees HBM when arrays are collected
+        managed.engine = None  # type: ignore[assignment]
+        return True
+
+    # -- resolution ---------------------------------------------------------
+
+    def get(self, name: str) -> Optional[ManagedModel]:
+        return self.models.get(name)
+
+    def ready_models(self) -> List[ManagedModel]:
+        return [m for m in self.models.values() if m.state == STATE_READY]
+
+    def find_by_partial_name(self, name: str) -> Optional[ManagedModel]:
+        """Case-insensitive substring match (model_manager.rs:506-518)."""
+        low = name.lower()
+        exact = self.models.get(name)
+        if exact is not None and exact.state == STATE_READY:
+            return exact
+        for m in self.ready_models():
+            if low in m.name.lower() or m.name.lower() in low:
+                return m
+        return None
+
+    def select_for_level(self, level: str) -> Optional[ManagedModel]:
+        """Routing ladder; None for reactive or when nothing matches."""
+        ladder = LEVEL_LADDERS.get(level.lower())
+        if not ladder:
+            return None
+        for candidate in ladder:
+            m = self.find_by_partial_name(candidate)
+            if m is not None:
+                return m
+        return None

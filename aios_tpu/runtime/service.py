@@ -1,0 +1,223 @@
+"""aios.runtime.AIRuntime gRPC service over the TPU engine.
+
+Reference parity (runtime/src/grpc_service.rs):
+  * resolution order for Infer: explicit model name -> intelligence-level
+    ladder -> any ready model -> UNAVAILABLE (grpc_service.rs:187-233);
+  * reactive level is rejected with INVALID_ARGUMENT ("heuristics, no model",
+    grpc_service.rs:208-211); strategic with no big model ready returns
+    FAILED_PRECONDITION "route via api-gateway" (grpc_service.rs:213-216);
+  * defaults: max_tokens 512, temperature 0.7 (inference.rs:103-112).
+
+Improvement over the reference: StreamInfer is genuinely token-by-token (the
+reference buffers the whole SSE body before chunking, inference.rs:257-353 —
+a quirk SURVEY.md says to fix consciously). Chunks carry incremental
+detokenized text; the final chunk has done=true and empty text.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Iterator, Optional
+
+import grpc
+
+from .. import rpc
+from ..proto_gen import common_pb2, runtime_pb2
+from ..services import RUNTIME, AIRuntimeServicer, service_address
+from ..engine.batching import Request
+from ..engine.tokenizer import render_chat
+from .model_manager import (
+    STATE_READY,
+    ManagedModel,
+    ModelManager,
+)
+
+log = logging.getLogger("aios.runtime")
+
+DEFAULT_MAX_TOKENS = 512
+DEFAULT_TEMPERATURE = 0.7
+DEFAULT_TOP_P = 0.95
+
+
+class RuntimeService(AIRuntimeServicer):
+    def __init__(self, manager: Optional[ModelManager] = None):
+        self.manager = manager or ModelManager()
+        self.started_at = time.time()
+
+    # -- lifecycle RPCs -----------------------------------------------------
+
+    def LoadModel(self, request, context):
+        try:
+            m = self.manager.load_model(
+                request.model_name,
+                request.model_path,
+                context_length=request.context_length,
+            )
+        except Exception as exc:  # noqa: BLE001
+            context.set_code(grpc.StatusCode.INTERNAL)
+            context.set_details(f"load failed: {exc}")
+            return runtime_pb2.ModelStatus(
+                model_name=request.model_name, status="error"
+            )
+        return self._status_of(m)
+
+    def UnloadModel(self, request, context):
+        ok = self.manager.unload_model(request.model_name)
+        return common_pb2.Status(
+            success=ok,
+            message="unloaded" if ok else f"model {request.model_name} not loaded",
+        )
+
+    def ListModels(self, request, context):
+        return runtime_pb2.ModelList(
+            models=[self._status_of(m) for m in self.manager.models.values()]
+        )
+
+    def HealthCheck(self, request, context):
+        details = {
+            m.name: m.state for m in self.manager.models.values()
+        }
+        details["backend"] = "jax-tpu"
+        ready = len(self.manager.ready_models())
+        return common_pb2.HealthStatus(
+            healthy=True,
+            service="runtime",
+            message=f"{ready} model(s) ready",
+            uptime_seconds=int(time.time() - self.started_at),
+            details=details,
+        )
+
+    # -- inference RPCs -----------------------------------------------------
+
+    def Infer(self, request, context):
+        t0 = time.time()
+        m = self._resolve_model(request, context)
+        if m is None:
+            return runtime_pb2.InferResponse()
+        handle, n_prompt = self._submit(m, request)
+        token_ids = [t for t in handle if t != m.tokenizer.eos_id]
+        text = m.tokenizer.decode(token_ids)
+        latency_ms = int((time.time() - t0) * 1000)
+        return runtime_pb2.InferResponse(
+            text=text,
+            tokens_used=n_prompt + len(token_ids),
+            latency_ms=latency_ms,
+            model_used=m.name,
+        )
+
+    def StreamInfer(self, request, context) -> Iterator[runtime_pb2.InferChunk]:
+        m = self._resolve_model(request, context)
+        if m is None:
+            return
+        handle, _ = self._submit(m, request)
+        emitted = ""
+        ids = []
+        for tok in handle:
+            if tok == m.tokenizer.eos_id:
+                break
+            ids.append(tok)
+            # incremental detokenization: emit the stable text delta
+            text = m.tokenizer.decode(ids)
+            if text.startswith(emitted):
+                delta = text[len(emitted) :]
+            else:  # rare resegmentation: resend from scratch marker
+                delta = text
+            if delta:
+                emitted = text
+                yield runtime_pb2.InferChunk(text=delta, done=False)
+        yield runtime_pb2.InferChunk(text="", done=True)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _submit(self, m: ManagedModel, request):
+        m.touch()
+        prompt_text = render_chat(
+            m.config.name, request.prompt, request.system_prompt
+        )
+        prompt_ids = m.tokenizer.encode(prompt_text)
+        stop = (m.tokenizer.eos_id,) if m.tokenizer.eos_id is not None else ()
+        req = Request(
+            prompt_ids=prompt_ids,
+            max_tokens=request.max_tokens or DEFAULT_MAX_TOKENS,
+            temperature=(
+                request.temperature
+                if request.temperature > 0
+                else DEFAULT_TEMPERATURE
+            ),
+            top_p=DEFAULT_TOP_P,
+            stop_ids=stop,
+            request_id=request.task_id or "",
+        )
+        return m.batcher.submit(req), len(prompt_ids)
+
+    def _resolve_model(self, request, context) -> Optional[ManagedModel]:
+        """explicit name -> level ladder -> any ready -> gRPC error."""
+        if request.model:
+            m = self.manager.find_by_partial_name(request.model)
+            if m is not None:
+                return m
+            context.set_code(grpc.StatusCode.NOT_FOUND)
+            context.set_details(f"model {request.model} not loaded")
+            return None
+
+        level = request.intelligence_level.lower()
+        if level == "reactive":
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(
+                "reactive tasks use heuristics, not model inference"
+            )
+            return None
+        if level:
+            m = self.manager.select_for_level(level)
+            if m is not None:
+                return m
+            if level == "strategic":
+                context.set_code(grpc.StatusCode.FAILED_PRECONDITION)
+                context.set_details(
+                    "no strategic-tier model loaded; route via api-gateway"
+                )
+                return None
+
+        ready = self.manager.ready_models()
+        if ready:
+            return ready[0]
+        context.set_code(grpc.StatusCode.UNAVAILABLE)
+        context.set_details("no models loaded")
+        return None
+
+    def _status_of(self, m: ManagedModel) -> runtime_pb2.ModelStatus:
+        return runtime_pb2.ModelStatus(
+            model_name=m.name,
+            status=m.state,
+            port=0,  # no HTTP sidecar on TPU
+            loaded_at=m.loaded_at,
+            last_used=m.last_used,
+            request_count=m.request_count,
+        )
+
+
+def serve(
+    address: Optional[str] = None,
+    manager: Optional[ModelManager] = None,
+    block: bool = True,
+):
+    """Start the runtime gRPC server (reference binds [::]:50055,
+    runtime/src/main.rs:140)."""
+    address = address or service_address("runtime")
+    server = rpc.create_server()
+    service = RuntimeService(manager)
+    rpc.add_to_server(RUNTIME, service, server)
+    port = server.add_insecure_port(address)
+    server.start()
+    log.info("AIRuntime listening on %s", address)
+    if block:
+        server.wait_for_termination()
+    return server, service, port
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    manager = ModelManager()
+    manager.autoload()
+    serve(manager=manager)
