@@ -1,0 +1,244 @@
+"""Memory service: tiers, embeddings, migration, context assembly, RPCs.
+
+Mirrors the reference's model-based memory tests (tests/integration/
+test_memory.rs exercises lifecycle semantics in-process) plus a live-socket
+pass over the 24-RPC surface.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from aios_tpu import rpc, services
+from aios_tpu.memory import embeddings
+from aios_tpu.memory.migration import MigrationPipeline
+from aios_tpu.memory.service import MemoryService
+from aios_tpu.memory.tiers import LongTermMemory, OperationalMemory, WorkingMemory
+from aios_tpu.proto_gen import memory_pb2 as pb
+
+
+# ---------------------------------------------------------------------------
+# Embeddings
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_is_normalized_and_deterministic():
+    v1 = embeddings.embed("restart the nginx service")
+    v2 = embeddings.embed("restart the nginx service")
+    np.testing.assert_array_equal(v1, v2)
+    assert v1.shape == (64,)
+    assert abs(float(np.linalg.norm(v1)) - 1.0) < 1e-5
+
+
+def test_similar_texts_score_higher():
+    q = "disk usage is high"
+    related = "alert: disk usage exceeded 90 percent"
+    unrelated = "the weather in paris is sunny"
+    qv = embeddings.embed(q)
+    s_rel = embeddings.hybrid_score(q, qv, related, embeddings.embed(related))
+    s_unrel = embeddings.hybrid_score(q, qv, unrelated, embeddings.embed(unrelated))
+    assert s_rel > s_unrel
+
+
+# ---------------------------------------------------------------------------
+# Tiers
+# ---------------------------------------------------------------------------
+
+
+def test_operational_ring_and_metrics():
+    op = OperationalMemory(capacity=5)
+    for i in range(8):
+        op.push_event({"category": "test", "source": "t", "data_json": str(i)})
+    events = op.recent_events(count=10)
+    assert len(events) == 5  # ring capacity enforced
+    assert events[0]["data_json"] == "7"  # newest first
+
+    t0 = time.perf_counter()
+    op.update_metric("cpu", 42.0)
+    got = op.get_metric("cpu")
+    assert got[0] == 42.0
+    assert time.perf_counter() - t0 < 0.001  # <1 ms operational target
+
+
+def test_working_goal_task_lifecycle(tmp_db_path):
+    w = WorkingMemory(tmp_db_path)
+    w.store_goal({"id": "g1", "description": "fix disk", "status": "in_progress"})
+    w.store_task({"id": "t1", "goal_id": "g1", "description": "check df"})
+    assert [g["id"] for g in w.active_goals()] == ["g1"]
+    assert len(w.tasks_for_goal("g1")) == 1
+    w.update_goal("g1", "completed", result="done")
+    assert w.active_goals() == []
+
+
+def test_pattern_stats_update():
+    w = WorkingMemory()
+    w.store_pattern({"id": "p1", "trigger": "high cpu", "action": "restart",
+                     "success_rate": 1.0, "uses": 1})
+    w.update_pattern_stats("p1", success=False)
+    p = w.find_pattern("high cpu")
+    assert p["uses"] == 2
+    assert p["success_rate"] == pytest.approx(0.5)
+    assert w.find_pattern("high cpu", min_success_rate=0.9) is None
+
+
+def test_pattern_pruning_keeps_best():
+    w = WorkingMemory()
+    for i in range(20):
+        w.store_pattern({"id": f"p{i}", "trigger": f"t{i}", "action": "a",
+                         "success_rate": i / 20.0, "uses": i})
+    removed = w.prune_patterns(cap=5)
+    assert removed == 15
+    assert w.find_pattern("t19") is not None
+    assert w.find_pattern("t0") is None
+
+
+def test_longterm_hybrid_search_ranks_relevant_first():
+    lt = LongTermMemory()
+    lt.store_memory("procedure for restarting nginx after config change",
+                    collection="procedures")
+    lt.store_memory("notes about TPU mesh topology", collection="general")
+    lt.store_memory("incident: nginx crashed due to OOM", collection="incidents")
+    got = lt.search("nginx restart", n_results=2)
+    assert len(got) == 2
+    assert "nginx" in got[0]["content"]
+
+
+def test_longterm_collection_filter():
+    lt = LongTermMemory()
+    lt.store_memory("alpha fact", collection="a")
+    lt.store_memory("alpha other", collection="b")
+    got = lt.search("alpha", collections=["a"], n_results=5)
+    assert len(got) == 1
+    assert got[0]["collection"] == "a"
+
+
+def test_knowledge_base_roundtrip():
+    lt = LongTermMemory()
+    lt.add_knowledge("Mesh sharding", "use pjit with NamedSharding over a Mesh",
+                     source="docs")
+    got = lt.search_knowledge("pjit sharding mesh")
+    assert got and "NamedSharding" in got[0]["content"]
+
+
+# ---------------------------------------------------------------------------
+# Migration
+# ---------------------------------------------------------------------------
+
+
+def test_migration_moves_finished_goals_and_extracts_procedures():
+    op, w, lt = OperationalMemory(), WorkingMemory(), LongTermMemory()
+    m = MigrationPipeline(op, w, lt)
+    old = int(time.time()) - 7200
+    w.store_goal({"id": "g1", "description": "rotate tls certs",
+                  "status": "completed", "completed_at": old})
+    # force completed_at into the past (update_goal stamps now)
+    w._exec("UPDATE goals SET completed_at=? WHERE id='g1'", (old,))
+    w.store_task({"id": "t1", "goal_id": "g1", "description": "issue new cert",
+                  "agent": "security_agent"})
+    op.push_event({"category": "old", "source": "x", "data_json": "{}",
+                   "timestamp": int(time.time()) - 90000})
+    op.push_event({"category": "new", "source": "x", "data_json": "{}"})
+
+    stats = m.run_once()
+    assert stats["goals"] == 1
+    assert stats["procedures"] == 1
+    assert stats["events"] == 1
+    # migrated out of working
+    assert w.tasks_for_goal("g1") == [] or w.active_goals() == []
+    got = lt.search("rotate tls certs", collections=["goal_history"])
+    assert got
+    # recent event is still in operational
+    assert len(op.recent_events()) == 1
+
+
+# ---------------------------------------------------------------------------
+# Full RPC surface over a socket
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def memory_stub():
+    from aios_tpu.memory.service import serve
+
+    server, service, port = serve(address="127.0.0.1:0", block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    yield services.MemoryServiceStub(channel)
+    channel.close()
+    server.stop(grace=None)
+
+
+def test_rpc_events_and_metrics(memory_stub):
+    memory_stub.PushEvent(
+        pb.Event(category="sys", source="test", data_json=b'{"x":1}')
+    )
+    events = memory_stub.GetRecentEvents(pb.RecentEventsRequest(count=5))
+    assert len(events.events) == 1
+    memory_stub.UpdateMetric(pb.MetricUpdate(key="cpu", value=55.5))
+    got = memory_stub.GetMetric(pb.MetricRequest(key="cpu"))
+    assert got.value == 55.5
+    snap = memory_stub.GetSystemSnapshot(pb.Empty())
+    assert snap.memory_total_mb > 0
+
+
+def test_rpc_goals_tasks_patterns(memory_stub):
+    memory_stub.StoreGoal(
+        pb.GoalRecord(id="g9", description="test goal", status="pending")
+    )
+    goals = memory_stub.GetActiveGoals(pb.Empty())
+    assert any(g.id == "g9" for g in goals.goals)
+    memory_stub.StoreTask(pb.TaskRecord(id="t9", goal_id="g9", description="step"))
+    tasks = memory_stub.GetTasksForGoal(pb.GoalIdRequest(goal_id="g9"))
+    assert len(tasks.tasks) == 1
+    memory_stub.StorePattern(
+        pb.Pattern(id="pp", trigger="disk full", action="clean /tmp",
+                   success_rate=0.9, uses=3)
+    )
+    found = memory_stub.FindPattern(pb.PatternQuery(trigger="disk"))
+    assert found.found and found.pattern.action == "clean /tmp"
+    memory_stub.UpdatePatternStats(pb.PatternStatsUpdate(id="pp", success=True))
+
+
+def test_rpc_agent_state(memory_stub):
+    memory_stub.StoreAgentState(
+        pb.AgentState(agent_name="system_agent", state_json=b'{"n":1}')
+    )
+    got = memory_stub.GetAgentState(pb.AgentStateRequest(agent_name="system_agent"))
+    assert got.state_json == b'{"n":1}'
+    missing = memory_stub.GetAgentState(pb.AgentStateRequest(agent_name="nope"))
+    assert missing.state_json == b""
+
+
+def test_rpc_semantic_search_and_knowledge(memory_stub):
+    memory_stub.StoreProcedure(
+        pb.Procedure(name="restart service", description="systemctl restart",
+                     steps_json=b"[]")
+    )
+    memory_stub.StoreIncident(
+        pb.Incident(description="OOM on nginx", root_cause="memory leak")
+    )
+    memory_stub.StoreConfigChange(
+        pb.ConfigChange(file_path="/etc/nginx.conf", content="worker=4",
+                        changed_by="test")
+    )
+    memory_stub.AddKnowledge(
+        pb.KnowledgeEntry(title="nginx tuning", content="raise worker count",
+                          source="docs")
+    )
+    hits = memory_stub.SearchKnowledge(
+        pb.SemanticSearchRequest(query="nginx workers", n_results=3)
+    )
+    assert hits.results
+
+
+def test_rpc_assemble_context_budget(memory_stub):
+    # stuff long-term with enough content to overflow a small budget
+    for i in range(10):
+        memory_stub.PushEvent(
+            pb.Event(category="load", source="t", data_json=b"x" * 200)
+        )
+    ctx = memory_stub.AssembleContext(
+        pb.ContextRequest(task_description="anything", max_tokens=50)
+    )
+    assert ctx.total_tokens <= 50
+    assert sum(c.tokens for c in ctx.chunks) == ctx.total_tokens
