@@ -1,0 +1,311 @@
+"""Tool registry: pipeline semantics, audit chain, plugins, RPC surface.
+
+Covers the reference's executor pipeline (validate -> caps -> rate ->
+backup -> execute -> audit, executor.rs:503-633), the hash-chain verifier
+(audit.rs:107-150), capability denial, rollback, plugin self-evolution and
+chaining — using only hermetic tools (fs.*, monitor.*, plugin.*).
+"""
+
+import json
+
+import pytest
+
+from aios_tpu import rpc, services
+from aios_tpu.proto_gen import tools_pb2 as pb
+from aios_tpu.tools.audit import AuditLog
+from aios_tpu.tools.capabilities import CapabilityChecker, requirements_for
+from aios_tpu.tools.executor import ToolExecutor
+from aios_tpu.tools.ratelimit import RateLimiter
+
+
+@pytest.fixture()
+def executor(tmp_path):
+    return ToolExecutor(
+        audit_path=str(tmp_path / "audit.db"),
+        backup_dir=str(tmp_path / "backups"),
+        plugin_dir=str(tmp_path / "plugins"),
+        secrets_path=str(tmp_path / "secrets.toml"),
+    )
+
+
+def _run(executor, tool, args, agent="autonomy-loop"):
+    return executor.execute(agent, tool, json.dumps(args).encode())
+
+
+# ---------------------------------------------------------------------------
+# Registry surface
+# ---------------------------------------------------------------------------
+
+
+def test_registry_has_reference_tool_surface(executor):
+    names = set(executor.registry)
+    # the 62+ handlers of executor.rs:111-501, spot-checked per namespace
+    for tool in [
+        "fs.read", "fs.write", "fs.delete", "fs.list", "fs.stat", "fs.mkdir",
+        "fs.move", "fs.copy", "fs.chmod", "fs.chown", "fs.symlink",
+        "fs.search", "fs.disk_usage",
+        "process.list", "process.spawn", "process.kill", "process.info",
+        "process.signal", "process.cgroup",
+        "service.list", "service.start", "service.stop", "service.restart",
+        "service.status",
+        "net.interfaces", "net.ping", "net.dns", "net.http_get",
+        "net.port_scan",
+        "firewall.rules", "firewall.add_rule", "firewall.delete_rule",
+        "pkg.install", "pkg.remove", "pkg.search", "pkg.update",
+        "pkg.list_installed",
+        "sec.check_perms", "sec.audit_query", "sec.grant", "sec.revoke",
+        "sec.audit", "sec.scan", "sec.cert_generate", "sec.cert_rotate",
+        "sec.file_integrity", "sec.scan_rootkits",
+        "monitor.cpu", "monitor.memory", "monitor.disk", "monitor.network",
+        "monitor.logs", "monitor.ebpf_trace", "monitor.fs_watch",
+        "hw.info",
+        "web.http_request", "web.scrape", "web.webhook", "web.download",
+        "web.api_call",
+        "git.init", "git.clone", "git.add", "git.commit", "git.push",
+        "git.pull", "git.branch", "git.status", "git.log", "git.diff",
+        "code.scaffold", "code.generate",
+        "self.inspect", "self.update", "self.rebuild", "self.health",
+        "plugin.create", "plugin.list", "plugin.delete", "plugin.install_deps",
+        "plugin.from_template",
+        "container.create", "container.start", "container.stop",
+        "container.list", "container.exec", "container.logs",
+        "email.send",
+    ]:
+        assert tool in names, f"missing tool {tool}"
+    assert len(names) >= 62
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_fs_roundtrip_with_audit(executor, tmp_path):
+    f = tmp_path / "hello.txt"
+    r = _run(executor, "fs.write", {"path": str(f), "content": "hi"})
+    assert r.success
+    r2 = _run(executor, "fs.read", {"path": str(f)})
+    assert r2.success and r2.output["content"] == "hi"
+    ok, bad = executor.audit.verify_chain()
+    assert ok and bad is None
+    assert executor.audit.count() == 2
+
+
+def test_unknown_tool_fails_and_audits(executor):
+    r = _run(executor, "fs.teleport", {})
+    assert not r.success and "unknown tool" in r.error
+    assert executor.audit.count() == 1
+
+
+def test_capability_denied(executor):
+    # monitoring_agent has no fs.write capability
+    r = _run(executor, "fs.write", {"path": "/tmp/x", "content": "x"},
+             agent="monitoring_agent")
+    assert not r.success
+    assert "lacks capabilities" in r.error
+
+
+def test_capability_grant_via_sec_tool(executor, tmp_path):
+    target = tmp_path / "g.txt"
+    denied = _run(executor, "fs.write", {"path": str(target), "content": "x"},
+                  agent="monitoring_agent")
+    assert not denied.success
+    granted = _run(executor, "sec.grant",
+                   {"agent_id": "monitoring_agent", "capabilities": ["fs.write"]})
+    assert granted.success
+    allowed = _run(executor, "fs.write", {"path": str(target), "content": "x"},
+                   agent="monitoring_agent")
+    assert allowed.success
+    _run(executor, "sec.revoke",
+         {"agent_id": "monitoring_agent", "capabilities": ["fs.write"]})
+    again = _run(executor, "fs.write", {"path": str(target), "content": "y"},
+                 agent="monitoring_agent")
+    assert not again.success
+
+
+def test_rate_limit_blocks_floods():
+    rl = RateLimiter(agent_rps=3, tool_rps=50)
+    allowed = sum(1 for _ in range(10) if rl.check("a1", "fs.read")[0])
+    assert allowed <= 4  # capacity burst only
+
+
+def test_backup_and_rollback(executor, tmp_path):
+    f = tmp_path / "cfg.txt"
+    f.write_text("original")
+    r = _run(executor, "fs.write", {"path": str(f), "content": "modified"})
+    assert r.success and r.backup_id
+    assert f.read_text() == "modified"
+    ok, msg = executor.rollback(r.execution_id)
+    assert ok, msg
+    assert f.read_text() == "original"
+
+
+def test_rollback_of_created_file_deletes_it(executor, tmp_path):
+    f = tmp_path / "new.txt"
+    r = _run(executor, "fs.write", {"path": str(f), "content": "x"})
+    assert f.exists()
+    ok, _ = executor.rollback(r.execution_id)
+    assert ok
+    assert not f.exists()
+
+
+def test_handler_error_becomes_result_error(executor):
+    r = _run(executor, "fs.read", {"path": "/nonexistent/deeply/missing"})
+    assert not r.success and "not a file" in r.error
+
+
+# ---------------------------------------------------------------------------
+# Audit chain
+# ---------------------------------------------------------------------------
+
+
+def test_audit_chain_detects_tampering(tmp_path):
+    log = AuditLog(str(tmp_path / "a.db"))
+    for i in range(5):
+        log.record("agent", f"tool{i}", b"{}", b"{}", True)
+    ok, _ = log.verify_chain()
+    assert ok
+    log.tamper_for_test(seq=3)
+    ok, bad = log.verify_chain()
+    assert not ok and bad == 3
+
+
+def test_sec_audit_tool_reports_chain(executor):
+    _run(executor, "monitor.cpu", {})
+    r = _run(executor, "sec.audit", {})
+    assert r.success and r.output["chain_valid"]
+    r2 = _run(executor, "sec.audit_query", {"tool_name": "monitor.cpu"})
+    assert r2.success and len(r2.output["records"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Capabilities metadata
+# ---------------------------------------------------------------------------
+
+
+def test_risk_levels():
+    assert requirements_for("fs.read")[1] == "low"
+    assert requirements_for("fs.delete")[1] == "high"
+    assert requirements_for("firewall.add_rule")[1] == "critical"
+    assert requirements_for("sec.grant")[1] == "critical"
+
+
+def test_agent_type_prefix_matching():
+    c = CapabilityChecker()
+    assert "net.diagnose" in c.grants_for("network_agent-x42")
+    assert c.grants_for("unknown-agent") == set()
+
+
+# ---------------------------------------------------------------------------
+# Plugins (self-evolution)
+# ---------------------------------------------------------------------------
+
+
+def test_plugin_create_execute_chain(executor):
+    r1 = _run(executor, "plugin.create", {
+        "name": "adder",
+        "code": "def main(input_data):\n"
+                "    return {'sum': input_data.get('a', 0) + input_data.get('b', 0)}\n",
+        "description": "adds a and b",
+    })
+    assert r1.success, r1.error
+    assert "plugin.x.adder" in executor.registry
+
+    r2 = _run(executor, "plugin.x.adder", {"a": 2, "b": 40})
+    assert r2.success, r2.error
+    assert r2.output["sum"] == 42
+
+    # chain: doubler pipes into adder? build second plugin chained to adder
+    r3 = _run(executor, "plugin.create", {
+        "name": "doubler",
+        "code": "def main(input_data):\n"
+                "    return {'a': input_data.get('x', 0) * 2, 'b': 1}\n",
+        "next_plugins": ["adder"],
+        "output_mode": "pipe",
+    })
+    assert r3.success
+    r4 = _run(executor, "plugin.x.doubler", {"x": 5})
+    assert r4.success and r4.output["sum"] == 11  # 5*2 + 1
+
+
+def test_plugin_rejects_bad_code(executor):
+    r = _run(executor, "plugin.create",
+             {"name": "broken", "code": "this is not python"})
+    assert not r.success
+    r2 = _run(executor, "plugin.create",
+              {"name": "nomain", "code": "x = 1"})
+    assert not r2.success and "main" in r2.error
+
+
+def test_plugin_from_template_and_delete(executor):
+    r = _run(executor, "plugin.from_template",
+             {"name": "echoer", "template": "basic"})
+    assert r.success
+    assert _run(executor, "plugin.x.echoer", {"k": 1}).output == {"echo": {"k": 1}}
+    r2 = _run(executor, "plugin.delete", {"name": "echoer"})
+    assert r2.success and r2.output["deleted"]
+    assert "plugin.x.echoer" not in executor.registry
+
+
+# ---------------------------------------------------------------------------
+# gRPC surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tools_stub(tmp_path_factory):
+    from aios_tpu.tools.service import serve
+
+    tmp = tmp_path_factory.mktemp("tools")
+    ex = ToolExecutor(
+        audit_path=str(tmp / "audit.db"),
+        backup_dir=str(tmp / "backups"),
+        plugin_dir=str(tmp / "plugins"),
+    )
+    server, service, port = serve(address="127.0.0.1:0", executor=ex, block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    yield services.ToolRegistryStub(channel)
+    channel.close()
+    server.stop(grace=None)
+
+
+def test_rpc_list_and_get(tools_stub):
+    resp = tools_stub.ListTools(pb.ListToolsRequest())
+    assert len(resp.tools) >= 62
+    fs_only = tools_stub.ListTools(pb.ListToolsRequest(namespace="fs"))
+    assert all(t.namespace == "fs" for t in fs_only.tools)
+    one = tools_stub.GetTool(pb.GetToolRequest(name="fs.delete"))
+    assert one.risk_level == "high" and one.reversible
+
+
+def test_rpc_execute_and_rollback(tools_stub, tmp_path):
+    f = tmp_path / "rpc.txt"
+    f.write_text("before")
+    resp = tools_stub.Execute(
+        pb.ExecuteRequest(
+            tool_name="fs.write",
+            agent_id="autonomy-loop",
+            input_json=json.dumps({"path": str(f), "content": "after"}).encode(),
+            reason="test",
+        )
+    )
+    assert resp.success
+    assert f.read_text() == "after"
+    rb = tools_stub.Rollback(pb.RollbackRequest(execution_id=resp.execution_id))
+    assert rb.success
+    assert f.read_text() == "before"
+
+
+def test_rpc_register_deregister(tools_stub):
+    resp = tools_stub.Register(
+        pb.RegisterToolRequest(
+            tool=pb.ToolDefinition(name="custom.thing", namespace="custom",
+                                   description="external"),
+            handler_address="127.0.0.1:7777",
+        )
+    )
+    assert resp.accepted
+    got = tools_stub.GetTool(pb.GetToolRequest(name="custom.thing"))
+    assert got.description == "external"
+    out = tools_stub.Deregister(pb.DeregisterToolRequest(tool_name="custom.thing"))
+    assert out.success
