@@ -1,0 +1,206 @@
+"""Provider clients: Claude, OpenAI, Qwen3 (OpenAI-compat), local TPU runtime.
+
+Reference parity (api-gateway/src/{claude,openai}.rs + router.rs):
+  * Claude native Messages API, default model claude-sonnet-4-20250514
+    (claude.rs:54-67), key from CLAUDE_API_KEY;
+  * OpenAI chat completions, default gpt-5, key from OPENAI_API_KEY;
+  * Qwen3 = OpenAI-compatible endpoint (default api.viwoapp.net,
+    model qwen3:30b-128k), key from QWEN3_API_KEY;
+  * local = the reference hits llama-server HTTP on 127.0.0.1:8082; here it
+    is the TPU runtime's gRPC Infer — always available, no key.
+
+Base URLs are env-overridable (CLAUDE_BASE_URL etc.) which is also how the
+offline test suite stubs them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+class ProviderError(Exception):
+    pass
+
+
+@dataclass
+class InferResult:
+    text: str
+    input_tokens: int
+    output_tokens: int
+    model: str
+    provider: str
+
+
+def _post_json(url: str, payload: dict, headers: dict, timeout: float) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **headers},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode("utf-8", "replace")[:500]
+        raise ProviderError(f"HTTP {exc.code} from {url}: {body}") from exc
+    except (OSError, ValueError) as exc:
+        raise ProviderError(f"request to {url} failed: {exc}") from exc
+
+
+class ClaudeClient:
+    name = "claude"
+
+    def __init__(self):
+        self.api_key = os.environ.get("CLAUDE_API_KEY", "")
+        self.base_url = os.environ.get("CLAUDE_BASE_URL", "https://api.anthropic.com")
+        self.model = os.environ.get("CLAUDE_MODEL", "claude-sonnet-4-20250514")
+        self.timeout = float(os.environ.get("CLAUDE_TIMEOUT", "120"))
+
+    def available(self) -> bool:
+        return bool(self.api_key)
+
+    def infer(self, prompt: str, system: str, max_tokens: int,
+              temperature: float) -> InferResult:
+        payload = {
+            "model": self.model,
+            "max_tokens": max_tokens or 1024,
+            "messages": [{"role": "user", "content": prompt}],
+            "temperature": temperature,
+        }
+        if system:
+            payload["system"] = system
+        data = _post_json(
+            f"{self.base_url}/v1/messages",
+            payload,
+            {"x-api-key": self.api_key, "anthropic-version": "2023-06-01"},
+            self.timeout,
+        )
+        try:
+            text = "".join(
+                b.get("text", "") for b in data["content"] if b.get("type") == "text"
+            )
+            usage = data.get("usage", {})
+            return InferResult(
+                text=text,
+                input_tokens=usage.get("input_tokens", 0),
+                output_tokens=usage.get("output_tokens", 0),
+                model=data.get("model", self.model),
+                provider=self.name,
+            )
+        except (KeyError, TypeError) as exc:
+            raise ProviderError(f"malformed claude response: {exc}") from exc
+
+
+class OpenAICompatClient:
+    """OpenAI chat-completions protocol (used by both openai and qwen3)."""
+
+    def __init__(self, name: str, key_env: str, base_env: str, default_base: str,
+                 model_env: str, default_model: str):
+        self.name = name
+        self.api_key = os.environ.get(key_env, "")
+        self.base_url = os.environ.get(base_env, default_base)
+        self.model = os.environ.get(model_env, default_model)
+        self.timeout = 120.0
+
+    def available(self) -> bool:
+        return bool(self.api_key)
+
+    def infer(self, prompt: str, system: str, max_tokens: int,
+              temperature: float) -> InferResult:
+        messages = []
+        if system:
+            messages.append({"role": "system", "content": system})
+        messages.append({"role": "user", "content": prompt})
+        data = _post_json(
+            f"{self.base_url}/v1/chat/completions",
+            {
+                "model": self.model,
+                "messages": messages,
+                "max_tokens": max_tokens or 1024,
+                "temperature": temperature,
+            },
+            {"Authorization": f"Bearer {self.api_key}"},
+            self.timeout,
+        )
+        try:
+            text = data["choices"][0]["message"]["content"]
+            usage = data.get("usage", {})
+            return InferResult(
+                text=text or "",
+                input_tokens=usage.get("prompt_tokens", 0),
+                output_tokens=usage.get("completion_tokens", 0),
+                model=data.get("model", self.model),
+                provider=self.name,
+            )
+        except (KeyError, IndexError, TypeError) as exc:
+            raise ProviderError(f"malformed {self.name} response: {exc}") from exc
+
+
+def openai_client() -> OpenAICompatClient:
+    return OpenAICompatClient(
+        "openai", "OPENAI_API_KEY", "OPENAI_BASE_URL",
+        "https://api.openai.com", "OPENAI_MODEL", "gpt-5",
+    )
+
+
+def qwen3_client() -> OpenAICompatClient:
+    return OpenAICompatClient(
+        "qwen3", "QWEN3_API_KEY", "QWEN3_BASE_URL",
+        "https://api.viwoapp.net", "QWEN3_MODEL", "qwen3:30b-128k",
+    )
+
+
+class LocalRuntimeClient:
+    """The TPU runtime as a gateway provider (final fallback, always on)."""
+
+    name = "local"
+
+    def __init__(self, address: Optional[str] = None):
+        from ..services import service_address
+
+        self.address = address or service_address("runtime")
+        self._stub = None
+
+    def available(self) -> bool:
+        return True  # router.rs treats local as always-available
+
+    def _get_stub(self):
+        if self._stub is None:
+            from .. import rpc
+            from ..services import AIRuntimeStub
+
+            self._stub = AIRuntimeStub(rpc.insecure_channel(self.address))
+        return self._stub
+
+    def infer(self, prompt: str, system: str, max_tokens: int,
+              temperature: float) -> InferResult:
+        import grpc
+
+        from ..proto_gen import runtime_pb2
+
+        try:
+            resp = self._get_stub().Infer(
+                runtime_pb2.InferRequest(
+                    prompt=prompt,
+                    system_prompt=system,
+                    max_tokens=max_tokens or 512,
+                    temperature=temperature,
+                ),
+                timeout=120,
+            )
+        except grpc.RpcError as exc:
+            self._stub = None
+            raise ProviderError(f"local runtime: {exc.details()}") from exc
+        return InferResult(
+            text=resp.text,
+            input_tokens=max(0, resp.tokens_used),
+            output_tokens=0,
+            model=resp.model_used or "local",
+            provider=self.name,
+        )

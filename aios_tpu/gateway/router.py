@@ -1,0 +1,155 @@
+"""Request routing: provider selection, fallback chains, response cache.
+
+Reference parity (api-gateway/src/router.rs):
+  * selection: preferred provider first, else claude > openai > qwen3 >
+    local by availability AND budget (router.rs:179-204);
+  * per-provider fallback chains on error when allow_fallback
+    (router.rs:55-93);
+  * response cache keyed by prompt hash, TTL 1 h, ~1000-entry LRU
+    (router.rs:206-248).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .budget import BudgetManager
+from .providers import (
+    ClaudeClient,
+    InferResult,
+    LocalRuntimeClient,
+    ProviderError,
+    openai_client,
+    qwen3_client,
+)
+
+PRIORITY = ["claude", "openai", "qwen3", "local"]
+FALLBACK_CHAINS: Dict[str, List[str]] = {
+    "claude": ["openai", "qwen3", "local"],
+    "openai": ["claude", "qwen3", "local"],
+    "qwen3": ["local"],
+    "local": [],
+}
+
+CACHE_TTL = 3600.0
+CACHE_MAX = 1000
+
+
+class ResponseCache:
+    def __init__(self, ttl: float = CACHE_TTL, max_entries: int = CACHE_MAX):
+        self.ttl = ttl
+        self.max_entries = max_entries
+        self._store: "collections.OrderedDict[str, Tuple[float, InferResult]]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(prompt: str, system: str, max_tokens: int, temperature: float) -> str:
+        blob = f"{prompt}\x00{system}\x00{max_tokens}\x00{temperature:.3f}"
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def get(self, key: str) -> Optional[InferResult]:
+        with self._lock:
+            entry = self._store.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            ts, result = entry
+            if time.monotonic() - ts > self.ttl:
+                del self._store[key]
+                self.misses += 1
+                return None
+            self._store.move_to_end(key)
+            self.hits += 1
+            return result
+
+    def put(self, key: str, result: InferResult) -> None:
+        with self._lock:
+            self._store[key] = (time.monotonic(), result)
+            self._store.move_to_end(key)
+            while len(self._store) > self.max_entries:
+                self._store.popitem(last=False)
+
+
+class RequestRouter:
+    def __init__(
+        self,
+        budget: Optional[BudgetManager] = None,
+        runtime_address: Optional[str] = None,
+    ):
+        self.providers = {
+            "claude": ClaudeClient(),
+            "openai": openai_client(),
+            "qwen3": qwen3_client(),
+            "local": LocalRuntimeClient(runtime_address),
+        }
+        self.budget = budget or BudgetManager()
+        self.cache = ResponseCache()
+        self.last_errors: Dict[str, str] = {}
+
+    def _usable(self, name: str) -> bool:
+        provider = self.providers[name]
+        return provider.available() and self.budget.can_afford(name)
+
+    def _selection_order(self, preferred: str, allow_fallback: bool) -> List[str]:
+        if preferred and preferred in self.providers:
+            order = [preferred]
+            if allow_fallback:
+                order += [p for p in FALLBACK_CHAINS[preferred] if p not in order]
+            return order
+        # no/unknown preference: global priority by availability & budget
+        order = [p for p in PRIORITY if self._usable(p)]
+        return order or ["local"]
+
+    def route(
+        self,
+        prompt: str,
+        system: str = "",
+        max_tokens: int = 1024,
+        temperature: float = 0.7,
+        preferred: str = "",
+        allow_fallback: bool = True,
+        agent: str = "",
+        task_id: str = "",
+        use_cache: bool = True,
+    ) -> InferResult:
+        cache_key = self.cache.key(prompt, system, max_tokens, temperature)
+        if use_cache:
+            hit = self.cache.get(cache_key)
+            if hit is not None:
+                return hit
+
+        errors: List[str] = []
+        for name in self._selection_order(preferred, allow_fallback):
+            if not self._usable(name):
+                errors.append(f"{name}: unavailable or over budget")
+                continue
+            try:
+                result = self.providers[name].infer(
+                    prompt, system, max_tokens, temperature
+                )
+            except ProviderError as exc:
+                self.last_errors[name] = str(exc)
+                errors.append(f"{name}: {exc}")
+                if not allow_fallback:
+                    break
+                continue
+            self.budget.record(
+                name,
+                result.model,
+                result.input_tokens,
+                result.output_tokens,
+                agent=agent,
+                task_id=task_id,
+            )
+            if use_cache:
+                self.cache.put(cache_key, result)
+            return result
+        raise ProviderError("all providers failed: " + "; ".join(errors))

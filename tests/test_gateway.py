@@ -1,0 +1,218 @@
+"""API gateway: provider selection, fallback, budget, cache, RPC surface.
+
+Cloud providers are stubbed with a local HTTP server speaking both the
+Claude Messages and OpenAI chat-completions protocols; the `local` provider
+is a stub AIRuntime gRPC server. The suite runs fully offline.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import grpc
+import pytest
+
+from aios_tpu import rpc, services
+from aios_tpu.gateway.budget import BudgetManager
+from aios_tpu.gateway.providers import ProviderError
+from aios_tpu.gateway.router import RequestRouter, ResponseCache
+from aios_tpu.proto_gen import api_gateway_pb2 as pb
+from aios_tpu.proto_gen import common_pb2, runtime_pb2
+
+
+class _StubCloud(BaseHTTPRequestHandler):
+    fail_providers: set = set()
+    calls: list = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        type(self).calls.append(self.path)
+        if self.path == "/v1/messages":  # Claude protocol
+            if "claude" in self.fail_providers:
+                self.send_error(500, "claude down")
+                return
+            resp = {
+                "model": body["model"],
+                "content": [{"type": "text", "text": f"claude says: {body['messages'][0]['content'][:20]}"}],
+                "usage": {"input_tokens": 100, "output_tokens": 50},
+            }
+        elif self.path == "/v1/chat/completions":  # OpenAI protocol
+            name = "openai" if "gpt" in body["model"] else "qwen3"
+            if name in self.fail_providers:
+                self.send_error(500, f"{name} down")
+                return
+            resp = {
+                "model": body["model"],
+                "choices": [{"message": {"content": f"{name} says hi"}}],
+                "usage": {"prompt_tokens": 80, "completion_tokens": 40},
+            }
+        else:
+            self.send_error(404)
+            return
+        out = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *args):
+        pass
+
+
+class _StubRuntime(services.AIRuntimeServicer):
+    def Infer(self, request, context):
+        return runtime_pb2.InferResponse(
+            text="local tpu response", tokens_used=10, model_used="tinyllama"
+        )
+
+
+@pytest.fixture(scope="module")
+def stub_endpoints():
+    http_server = HTTPServer(("127.0.0.1", 0), _StubCloud)
+    threading.Thread(target=http_server.serve_forever, daemon=True).start()
+    http_port = http_server.server_port
+
+    grpc_server = rpc.create_server()
+    rpc.add_to_server(services.RUNTIME, _StubRuntime(), grpc_server)
+    grpc_port = grpc_server.add_insecure_port("127.0.0.1:0")
+    grpc_server.start()
+    yield f"http://127.0.0.1:{http_port}", f"127.0.0.1:{grpc_port}"
+    http_server.shutdown()
+    grpc_server.stop(grace=None)
+
+
+@pytest.fixture()
+def router(stub_endpoints, monkeypatch):
+    base, runtime_addr = stub_endpoints
+    for var, val in {
+        "CLAUDE_API_KEY": "test-key",
+        "OPENAI_API_KEY": "test-key",
+        "QWEN3_API_KEY": "test-key",
+        "CLAUDE_BASE_URL": base,
+        "OPENAI_BASE_URL": base,
+        "QWEN3_BASE_URL": base,
+    }.items():
+        monkeypatch.setenv(var, val)
+    _StubCloud.fail_providers = set()
+    _StubCloud.calls = []
+    return RequestRouter(budget=BudgetManager(), runtime_address=runtime_addr)
+
+
+def test_priority_selects_claude_first(router):
+    result = router.route("hello world")
+    assert result.provider == "claude"
+    assert "claude says" in result.text
+
+
+def test_fallback_chain_on_provider_error(router):
+    _StubCloud.fail_providers = {"claude"}
+    result = router.route("try again", preferred="claude", allow_fallback=True)
+    assert result.provider == "openai"
+
+
+def test_no_fallback_when_disallowed(router):
+    _StubCloud.fail_providers = {"claude"}
+    with pytest.raises(ProviderError):
+        router.route("no fb", preferred="claude", allow_fallback=False,
+                     use_cache=False)
+
+
+def test_local_is_final_fallback(router):
+    _StubCloud.fail_providers = {"claude", "openai", "qwen3"}
+    result = router.route("anyone?", preferred="claude", allow_fallback=True)
+    assert result.provider == "local"
+    assert result.text == "local tpu response"
+
+
+def test_missing_keys_route_local(stub_endpoints, monkeypatch):
+    for var in ("CLAUDE_API_KEY", "OPENAI_API_KEY", "QWEN3_API_KEY"):
+        monkeypatch.delenv(var, raising=False)
+    r = RequestRouter(budget=BudgetManager(), runtime_address=stub_endpoints[1])
+    result = r.route("local only")
+    assert result.provider == "local"
+
+
+def test_budget_exhaustion_skips_provider(router):
+    router.budget.claude_budget = 0.0001
+    router.budget.record("claude", "m", 1_000_000, 1_000_000)  # blow the budget
+    result = router.route("over budget", use_cache=False)
+    assert result.provider != "claude"
+
+
+def test_budget_accounting_and_warning():
+    b = BudgetManager(claude_budget=10.0, openai_budget=5.0)
+    b.record("claude", "m", 1_000_000, 0)  # $3
+    assert b.used("claude") == pytest.approx(3.0)
+    assert b.warning("claude") == ""
+    b.record("claude", "m", 2_000_000, 0)  # +$6 = $9 => 90%
+    assert "90%" in b.warning("claude")
+    s = b.status()
+    assert not s["budget_exceeded"]
+    b.record("claude", "m", 1_000_000, 0)  # $12 > $10
+    assert b.status()["budget_exceeded"]
+
+
+def test_response_cache_hit(router):
+    r1 = router.route("cache me", temperature=0.0)
+    n_calls = len(_StubCloud.calls)
+    r2 = router.route("cache me", temperature=0.0)
+    assert len(_StubCloud.calls) == n_calls  # no extra provider hit
+    assert r1.text == r2.text
+    assert router.cache.hits == 1
+
+
+def test_cache_lru_eviction():
+    c = ResponseCache(max_entries=3)
+    from aios_tpu.gateway.providers import InferResult
+
+    for i in range(5):
+        c.put(c.key(f"p{i}", "", 10, 0.0), InferResult(f"t{i}", 0, 0, "m", "p"))
+    assert c.get(c.key("p0", "", 10, 0.0)) is None  # evicted
+    assert c.get(c.key("p4", "", 10, 0.0)) is not None
+
+
+# ---------------------------------------------------------------------------
+# gRPC surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def gateway_stub(router):
+    from aios_tpu.gateway.service import serve
+
+    server, service, port = serve(address="127.0.0.1:0", router=router, block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    yield services.ApiGatewayStub(channel)
+    channel.close()
+    server.stop(grace=None)
+
+
+def test_rpc_infer_and_usage(gateway_stub):
+    resp = gateway_stub.Infer(
+        pb.ApiInferRequest(prompt="hello rpc", requesting_agent="test-agent")
+    )
+    assert resp.text
+    assert resp.model_used.startswith("claude/")
+    usage = gateway_stub.GetUsage(pb.UsageRequest(provider="claude"))
+    assert usage.total_requests >= 1
+    assert usage.records[0].requesting_agent == "test-agent"
+    budget = gateway_stub.GetBudget(common_pb2.Empty())
+    assert budget.claude_monthly_budget_usd == 100.0
+
+
+def test_rpc_stream_infer(gateway_stub):
+    chunks = list(gateway_stub.StreamInfer(pb.ApiInferRequest(prompt="stream me")))
+    assert chunks[-1].done
+    assert "".join(c.text for c in chunks)
+
+
+def test_rpc_all_fail_unavailable(gateway_stub):
+    _StubCloud.fail_providers = {"claude", "openai", "qwen3"}
+    # local still works, so force preferred=qwen3 without fallback
+    with pytest.raises(grpc.RpcError) as err:
+        gateway_stub.Infer(
+            pb.ApiInferRequest(prompt="x", preferred_provider="qwen3",
+                               allow_fallback=False)
+        )
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
