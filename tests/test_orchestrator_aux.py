@@ -1,0 +1,144 @@
+"""Orchestrator auxiliaries: scheduler/cron, event bus, cluster, telemetry.
+
+Pure-state tests mirroring the reference's inline module tests
+(scheduler.rs:228-256, cluster.rs:161-214, event_bus.rs, decision_logger.rs).
+"""
+
+import time
+
+from aios_tpu.orchestrator.cluster import ClusterManager, ClusterNode
+from aios_tpu.orchestrator.event_bus import Event, EventBus, Subscription
+from aios_tpu.orchestrator.scheduler import GoalScheduler, matches_cron
+from aios_tpu.orchestrator.telemetry import (
+    Decision,
+    DecisionLogger,
+    ResultAggregator,
+    TaskOutcome,
+)
+
+
+# ---------------------------------------------------------------------------
+# Cron matcher (scheduler.rs:186-226)
+# ---------------------------------------------------------------------------
+
+
+def _t(minute=0, hour=0, mday=1, mon=1, wday=0):
+    return time.struct_time((2026, mon, mday, hour, minute, 0, wday, 1, -1))
+
+
+def test_cron_wildcards_and_values():
+    assert matches_cron("* * * * *", _t())
+    assert matches_cron("30 14 * * *", _t(minute=30, hour=14))
+    assert not matches_cron("30 14 * * *", _t(minute=31, hour=14))
+
+
+def test_cron_steps_and_lists():
+    assert matches_cron("*/15 * * * *", _t(minute=45))
+    assert not matches_cron("*/15 * * * *", _t(minute=46))
+    assert matches_cron("0,30 * * * *", _t(minute=30))
+    assert matches_cron("* * * * 0,4", _t(wday=4))
+    assert matches_cron("0 9-17 * * *", _t(hour=12))
+    assert not matches_cron("0 9-17 * * *", _t(hour=8))
+    assert not matches_cron("bad cron", _t())
+
+
+def test_scheduler_fires_and_debounces(tmp_db_path):
+    fired = []
+    s = GoalScheduler(lambda desc, prio: fired.append((desc, prio)),
+                      db_path=tmp_db_path)
+    sid = s.create("* * * * *", "periodic health sweep", priority=3)
+    assert s.tick() == 1
+    assert fired == [("periodic health sweep", 3)]
+    assert s.tick() == 0  # same minute -> debounced via last_run
+    assert len(s.list()) == 1
+    assert s.delete(sid)
+    assert s.tick() == 0
+
+
+# ---------------------------------------------------------------------------
+# Event bus
+# ---------------------------------------------------------------------------
+
+
+def test_event_bus_goal_creation_with_substitution():
+    goals = []
+    bus = EventBus(submit_goal=lambda d, p: goals.append((d, p)))
+    bus.subscribe(Subscription(
+        pattern="service.*",
+        min_severity="error",
+        goal_template="remediate {event_type} from {source}",
+        priority=8,
+    ))
+    bus.publish(Event("service.crashed", "health-checker", severity="error"))
+    bus.publish(Event("service.started", "init", severity="info"))  # below sev
+    bus.publish(Event("disk.full", "monitor", severity="critical"))  # no match
+    assert goals == [("remediate service.crashed from health-checker", 8)]
+    assert bus.published == 3
+    assert len(bus.recent_events()) == 3
+
+
+def test_event_bus_callback_subscription():
+    seen = []
+    bus = EventBus()
+    bus.subscribe(Subscription(pattern="*", callback=seen.append))
+    bus.publish(Event("anything.goes", "test"))
+    assert len(seen) == 1 and seen[0].event_type == "anything.goes"
+
+
+# ---------------------------------------------------------------------------
+# Cluster manager (cluster.rs:161-214)
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_least_loaded_routing():
+    c = ClusterManager()
+    c.register(ClusterNode("n1", "host1", "10.0.0.1:50051", max_tasks=10))
+    c.register(ClusterNode("n2", "host2", "10.0.0.2:50051", max_tasks=10))
+    c.heartbeat("n1", cpu=80.0, memory=50.0, active_tasks=8)
+    c.heartbeat("n2", cpu=20.0, memory=30.0, active_tasks=1)
+    assert c.least_loaded().node_id == "n2"
+
+
+def test_cluster_dead_node_pruning():
+    c = ClusterManager()
+    n = ClusterNode("n1", "h", "a:1")
+    c.register(n)
+    assert c.nodes() and not c.prune_dead()
+    n.last_heartbeat -= 60  # exceed the 30 s timeout
+    assert c.nodes() == []
+    assert c.prune_dead() == ["n1"]
+
+
+def test_cluster_full_nodes_not_routable():
+    c = ClusterManager()
+    c.register(ClusterNode("n1", "h", "a:1", max_tasks=2))
+    c.heartbeat("n1", cpu=10, memory=10, active_tasks=2)
+    assert c.least_loaded() is None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_result_aggregator_summary():
+    agg = ResultAggregator()
+    agg.record("g1", TaskOutcome("t1", True, tokens_used=100,
+                                 duration_ms=50, model_used="tinyllama"))
+    agg.record("g1", TaskOutcome("t2", False, error="x", tokens_used=20,
+                                 duration_ms=10, model_used="mistral"))
+    s = agg.summary("g1")
+    assert s.total_tasks == 2 and s.succeeded == 1 and s.failed == 1
+    assert s.total_tokens == 120
+    assert s.models_used == ["tinyllama", "mistral"]
+
+
+def test_decision_logger_ring_and_success_rate():
+    d = DecisionLogger(capacity=5)
+    for i in range(8):
+        d.log(Decision(context=f"c{i}", options=["a", "b"], chosen="a",
+                       reasoning="r", outcome="success" if i % 2 else "failure"))
+    assert len(d) == 5  # ring bounded
+    rate = d.success_rate()
+    assert rate is not None and 0.0 <= rate <= 1.0
+    assert d.success_rate("no-such-context") is None

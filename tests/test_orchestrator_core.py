@@ -1,0 +1,410 @@
+"""Orchestrator core: goal engine, planner, router, autonomy ladder.
+
+Model-based tests in the style of the reference's tests/integration/
+test_orchestrator.rs — lifecycle/cascade/dependency semantics exercised
+in-process with injected fake AI backends and tool executors.
+"""
+
+import json
+import time
+
+import pytest
+
+from aios_tpu.orchestrator.agent_router import AgentRouter, TrackedAgent
+from aios_tpu.orchestrator.autonomy import (
+    AutonomyConfig,
+    AutonomyLoop,
+    heuristic_tool_calls,
+    parse_tool_calls,
+)
+from aios_tpu.orchestrator.goal_engine import GoalEngine, Task
+from aios_tpu.orchestrator.task_planner import (
+    TaskPlanner,
+    classify_complexity,
+    extract_json_array,
+    infer_required_tools,
+    strip_think_tags,
+)
+
+
+# ---------------------------------------------------------------------------
+# Goal engine
+# ---------------------------------------------------------------------------
+
+
+def test_goal_lifecycle_and_persistence(tmp_db_path):
+    e = GoalEngine(tmp_db_path)
+    g = e.submit_goal("check disk space", priority=7)
+    assert g.status == "pending"
+    t1 = Task(id="t1", goal_id=g.id, description="step 1")
+    t2 = Task(id="t2", goal_id=g.id, description="step 2", depends_on=["t1"])
+    e.add_tasks(g.id, [t1, t2])
+    assert e.goals[g.id].status == "in_progress"
+
+    # dependency gating
+    unblocked = e.unblocked_pending_tasks()
+    assert [t.id for t in unblocked] == ["t1"]
+    e.complete_task("t1")
+    assert [t.id for t in e.unblocked_pending_tasks()] == ["t2"]
+    e.complete_task("t2")
+    assert e.check_goal_completion(g.id) == "completed"
+    assert e.progress(g.id) == 100.0
+
+    # reload from SQLite
+    e2 = GoalEngine(tmp_db_path)
+    assert e2.goals[g.id].status == "completed"
+    assert len(e2.tasks_for_goal(g.id)) == 2
+
+
+def test_crash_recovery_resets_in_progress(tmp_db_path):
+    e = GoalEngine(tmp_db_path)
+    g = e.submit_goal("long running")
+    t = Task(id="t1", goal_id=g.id, description="work")
+    e.add_tasks(g.id, [t])
+    e.set_task_status("t1", "in_progress", agent="agent-x")
+
+    e2 = GoalEngine(tmp_db_path)
+    n = e2.recover()
+    assert n == 1
+    assert e2.tasks["t1"].status == "pending"
+    assert e2.tasks["t1"].assigned_agent == ""
+
+
+def test_goal_cancellation_cascades():
+    e = GoalEngine()
+    g = e.submit_goal("cancel me")
+    e.add_tasks(g.id, [Task(id="t1", goal_id=g.id, description="a"),
+                       Task(id="t2", goal_id=g.id, description="b")])
+    assert e.cancel_goal(g.id)
+    assert all(t.status == "cancelled" for t in e.tasks_for_goal(g.id))
+    assert not e.cancel_goal(g.id)  # already terminal
+
+
+def test_failed_task_fails_goal():
+    e = GoalEngine()
+    g = e.submit_goal("will fail")
+    e.add_tasks(g.id, [Task(id="t1", goal_id=g.id, description="a")])
+    e.set_task_status("t1", "failed", error="boom")
+    assert e.check_goal_completion(g.id) == "failed"
+
+
+def test_conversation_thread():
+    e = GoalEngine()
+    g = e.submit_goal("chat goal")
+    e.add_message(g.id, "user", "please do the thing")
+    e.add_message(g.id, "assistant", "which thing?")
+    msgs = e.messages_for_goal(g.id)
+    assert [m.role for m in msgs] == ["user", "assistant"]
+    assert e.count_messages(g.id, role="assistant") == 1
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+
+def test_classify_complexity_ladder():
+    assert classify_complexity("ping 8.8.8.8") == "reactive"
+    assert classify_complexity("restart the web service") == "operational"
+    assert classify_complexity("investigate high memory usage") == "tactical"
+    assert classify_complexity("design a backup system") == "strategic"
+
+
+def test_infer_required_tools():
+    assert "service" in infer_required_tools("restart nginx")
+    assert "net" in infer_required_tools("check network connectivity")
+    assert "sec" in infer_required_tools("run a security audit")
+    assert infer_required_tools("compose a sonnet") == []
+
+
+def test_think_tag_stripping_and_json_extraction():
+    raw = '<think>hmm let me think</think>```json\n[{"description": "a"}]\n```'
+    assert strip_think_tags(raw).startswith("```json")
+    arr = extract_json_array(raw)
+    assert arr == [{"description": "a"}]
+    assert extract_json_array("no json here") is None
+    assert extract_json_array('text before [1, 2, 3] after') == [1, 2, 3]
+
+
+def test_operational_goal_single_task():
+    p = TaskPlanner()
+    e = GoalEngine()
+    g = e.submit_goal("restart the nginx service")
+    tasks = p.decompose_goal(g)
+    assert len(tasks) == 1
+    assert tasks[0].required_tools == ["service"]
+
+
+def test_tactical_ai_decomposition_with_chaining():
+    def fake_ai(prompt):
+        return json.dumps([
+            {"description": "scan ports", "required_tools": ["net"]},
+            {"description": "check perms", "required_tools": ["sec"]},
+            {"description": "summarize", "required_tools": ["monitor"]},
+        ])
+
+    p = TaskPlanner(gateway_infer=fake_ai)
+    e = GoalEngine()
+    g = e.submit_goal("audit the system security")
+    tasks = p.decompose_goal(g)
+    assert len(tasks) == 3
+    assert tasks[0].depends_on == []
+    assert tasks[1].depends_on == [tasks[0].id]
+    assert tasks[2].depends_on == [tasks[1].id]
+    assert all(t.intelligence_level == "tactical" for t in tasks)
+
+
+def test_ai_decompose_falls_back_on_garbage_then_keywords():
+    p = TaskPlanner(gateway_infer=lambda prompt: "I cannot help with that")
+    e = GoalEngine()
+    g = e.submit_goal("audit security posture")
+    tasks = p.decompose_goal(g)
+    assert len(tasks) >= 3  # keyword security fallback kicks in
+
+
+def test_gateway_error_falls_to_runtime():
+    def broken(prompt):
+        raise RuntimeError("gateway down")
+
+    def runtime(prompt):
+        return '[{"description": "only step", "required_tools": ["fs"]}]'
+
+    p = TaskPlanner(gateway_infer=broken, runtime_infer=runtime)
+    e = GoalEngine()
+    g = e.submit_goal("investigate the disk errors")
+    tasks = p.decompose_goal(g)
+    assert len(tasks) == 1
+    assert tasks[0].required_tools == ["fs"]
+
+
+# ---------------------------------------------------------------------------
+# Agent router
+# ---------------------------------------------------------------------------
+
+
+def _agent(aid, namespaces, completed=0):
+    return TrackedAgent(agent_id=aid, agent_type=aid.split("-")[0],
+                        tool_namespaces=namespaces,
+                        tasks_completed=completed)
+
+
+def test_routing_prefers_idle_then_experienced():
+    r = AgentRouter()
+    r.register(_agent("sys-1", ["fs", "service"], completed=2))
+    r.register(_agent("sys-2", ["fs", "service"], completed=9))
+    busy = _agent("sys-3", ["fs", "service"], completed=50)
+    busy.status = "busy"
+    busy.current_task_id = "other"
+    r.register(busy)
+
+    t = Task(id="t", goal_id="g", description="x", required_tools=["service"])
+    chosen = r.route_task(t)
+    assert chosen == "sys-2"  # idle with most experience
+
+
+def test_empty_required_tools_unroutable():
+    r = AgentRouter()
+    r.register(_agent("sys-1", ["fs"]))
+    t = Task(id="t", goal_id="g", description="think about stuff")
+    assert r.route_task(t) is None
+
+
+def test_dead_agent_detection_and_requeue():
+    r = AgentRouter()
+    a = _agent("sys-1", ["fs"])
+    r.register(a)
+    t = Task(id="t", goal_id="g", description="x", required_tools=["fs"])
+    assert r.route_task(t) == "sys-1"
+    a.last_heartbeat -= 20  # simulate heartbeat timeout (15 s)
+    assert [d.agent_id for d in r.dead_agents()] == ["sys-1"]
+    requeued = r.requeue_from("sys-1")
+    assert [t.id for t in requeued] == ["t"]
+
+
+def test_polling_queue():
+    r = AgentRouter()
+    r.register(_agent("sys-1", ["fs"]))
+    t = Task(id="t", goal_id="g", description="x", required_tools=["fs"])
+    r.route_task(t)
+    got = r.next_task_for("sys-1")
+    assert got.id == "t"
+    assert r.next_task_for("sys-1") is None
+
+
+# ---------------------------------------------------------------------------
+# Tool-call parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tool_calls_formats():
+    calls, done, thought = parse_tool_calls(
+        '{"thought": "checking", "tool_calls": [{"tool": "monitor.cpu", "args": {}}], "done": false}'
+    )
+    assert calls == [{"tool": "monitor.cpu", "args": {}}]
+    assert not done and thought == "checking"
+
+    calls, done, _ = parse_tool_calls('{"done": true, "thought": "all good", "tool_calls": []}')
+    assert done and not calls
+
+    calls, _, _ = parse_tool_calls(
+        'Sure! ```json\n{"tool_calls": [{"name": "fs.read", "input": {"path": "/x"}}]}\n```'
+    )
+    assert calls == [{"tool": "fs.read", "args": {"path": "/x"}}]
+
+    calls, _, _ = parse_tool_calls('I will call monitor.cpu({}) now')
+    assert calls == [{"tool": "monitor.cpu", "args": {}}]
+
+
+def test_heuristic_tool_mapping():
+    t = Task(id="t", goal_id="g", description="check cpu usage")
+    assert heuristic_tool_calls(t) == [{"tool": "monitor.cpu", "args": {}}]
+    t2 = Task(id="t", goal_id="g", description="ping 1.1.1.1")
+    assert heuristic_tool_calls(t2) == [{"tool": "net.ping",
+                                         "args": {"host": "1.1.1.1"}}]
+    t3 = Task(id="t", goal_id="g", description="write a haiku")
+    assert heuristic_tool_calls(t3) is None
+    t4 = Task(id="t", goal_id="g", description="custom",
+              input={"tool_calls": [{"tool": "fs.list", "args": {"path": "/"}}]})
+    assert heuristic_tool_calls(t4) == [{"tool": "fs.list",
+                                         "args": {"path": "/"}}]
+
+
+# ---------------------------------------------------------------------------
+# Autonomy loop (injected fakes, no sockets)
+# ---------------------------------------------------------------------------
+
+
+class FakeTools:
+    def __init__(self, fail_on=()):
+        self.calls = []
+        self.fail_on = set(fail_on)
+
+    def __call__(self, tool, agent_id, args):
+        self.calls.append((tool, agent_id, args))
+        if tool in self.fail_on:
+            return {"success": False, "output": {}, "error": f"{tool} broke"}
+        return {"success": True, "output": {"tool": tool, "ok": True},
+                "error": ""}
+
+
+def _loop(engine, planner=None, tools=None, gateway=None, runtime=None):
+    return AutonomyLoop(
+        engine=engine,
+        planner=planner or TaskPlanner(),
+        router=AgentRouter(),
+        execute_tool=tools or FakeTools(),
+        gateway_infer=gateway,
+        runtime_infer=runtime,
+        config=AutonomyConfig(tick_interval=0.01),
+    )
+
+
+def _drain(loop, timeout=10.0):
+    """Tick until no pending/in-flight work or timeout."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        loop.tick()
+        pending = loop.engine.unblocked_pending_tasks(limit=100)
+        with loop._lock:
+            busy = bool(loop._in_flight)
+        if not pending and not busy:
+            return
+        time.sleep(0.02)
+
+
+def test_heuristic_path_completes_goal():
+    e = GoalEngine()
+    tools = FakeTools()
+    loop = _loop(e, tools=tools)
+    g = e.submit_goal("check cpu usage")
+    _drain(loop)
+    assert e.goals[g.id].status == "completed"
+    assert tools.calls[0][0] == "monitor.cpu"
+
+
+def test_ai_reasoning_loop_multi_round():
+    e = GoalEngine()
+    tools = FakeTools()
+    replies = iter([
+        '{"thought": "inspect", "tool_calls": [{"tool": "monitor.logs", "args": {}}], "done": false}',
+        '{"thought": "fixed the problem", "tool_calls": [], "done": true}',
+    ])
+
+    loop = _loop(e, tools=tools, gateway=lambda p, lvl: next(replies))
+    g = e.submit_goal("investigate strange log entries")  # tactical, 3 rounds
+    _drain(loop)
+    assert e.goals[g.id].status == "completed"
+    task = e.tasks_for_goal(g.id)[0]
+    assert task.output["answer"] == "fixed the problem"
+    assert tools.calls[0][0] == "monitor.logs"
+
+
+def test_tool_failure_fails_task_and_goal():
+    e = GoalEngine()
+    tools = FakeTools(fail_on={"monitor.cpu"})
+    loop = _loop(e, tools=tools)
+    g = e.submit_goal("check cpu usage")
+    _drain(loop)
+    assert e.goals[g.id].status == "failed"
+    assert "broke" in e.tasks_for_goal(g.id)[0].error
+
+
+def test_json_self_correction_round():
+    e = GoalEngine()
+    tools = FakeTools()
+    replies = iter([
+        "sorry, here is prose with no JSON at all",
+        '{"thought": "ok", "tool_calls": [{"tool": "fs.list", "args": {"path": "/tmp"}}], "done": true}',
+    ])
+    prompts = []
+
+    def gateway(p, lvl):
+        prompts.append(p)
+        return next(replies)
+
+    loop = _loop(e, tools=tools, gateway=gateway)
+    g = e.submit_goal("tidy up temp folder somehow")  # operational -> 1 round
+    _drain(loop)
+    assert e.goals[g.id].status == "completed"
+    assert "not valid JSON" in prompts[1]
+
+
+def test_zero_tool_calls_awaits_input_then_fails():
+    e = GoalEngine()
+    loop = _loop(
+        e,
+        gateway=lambda p, lvl: '{"thought": "what exactly should I delete?", "tool_calls": [], "done": true}',
+    )
+    g = e.submit_goal("handle the thing appropriately")
+    for _ in range(12):
+        loop.tick()
+        time.sleep(0.05)
+    # after MAX_AI_MESSAGES assistant questions, the task fails
+    _drain(loop)
+    assert e.goals[g.id].status == "failed"
+    assert e.count_messages(g.id, role="assistant") >= 3
+
+
+def test_no_ai_backend_fails_ai_task():
+    e = GoalEngine()
+    loop = _loop(e)  # neither gateway nor runtime
+    g = e.submit_goal("compose a summary of recent activity")
+    _drain(loop)
+    assert e.goals[g.id].status == "failed"
+    assert "no AI backend" in e.tasks_for_goal(g.id)[0].error
+
+
+def test_agent_routing_preferred_over_ai():
+    e = GoalEngine()
+    loop = _loop(e)
+    agent = TrackedAgent(agent_id="system_agent-1", agent_type="system",
+                         tool_namespaces=["service", "monitor"])
+    loop.router.register(agent)
+    g = e.submit_goal("restart the nginx service")
+    loop.tick()
+    task = e.tasks_for_goal(g.id)[0]
+    assert task.status == "assigned"
+    assert task.assigned_agent == "system_agent-1"
+    # the agent polls it
+    polled = loop.router.next_task_for("system_agent-1")
+    assert polled.id == task.id
