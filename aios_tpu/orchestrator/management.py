@@ -1,0 +1,324 @@
+"""Management console: REST + WebSocket + embedded dashboard on :9090.
+
+Reference parity (agent-core/src/management.rs:43-54 routes, 757+ dashboard):
+  GET  /api/status            system summary
+  GET  /api/goals             goal list        POST /api/goals  submit
+  GET  /api/goals/{id}/tasks  task list
+  GET  /api/goals/{id}/messages  conversation thread
+  POST /api/chat              chat-style goal submission
+  GET  /api/agents            live agents
+  GET  /api/health            liveness
+  WS   /ws                    event push with subscribe_goal
+plus a single-file embedded HTML dashboard at /.
+
+Implemented with aiohttp on a dedicated thread/event loop (the reference
+uses axum inside tokio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from typing import Optional, Set
+
+from aiohttp import WSMsgType, web
+
+log = logging.getLogger("aios.console")
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>aiOS-TPU Console</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#0d1117;color:#e6edf3}
+ header{padding:12px 20px;background:#161b22;border-bottom:1px solid #30363d}
+ h1{font-size:16px;margin:0}
+ main{display:grid;grid-template-columns:1fr 1fr;gap:16px;padding:16px}
+ section{background:#161b22;border:1px solid #30363d;border-radius:8px;padding:12px}
+ h2{font-size:13px;margin:0 0 8px;color:#7d8590;text-transform:uppercase}
+ #goals div,#agents div{padding:6px;border-bottom:1px solid #21262d;font-size:13px}
+ .status{float:right;font-size:11px;padding:1px 8px;border-radius:10px;background:#1f6feb33}
+ .completed{background:#23863633}.failed{background:#da363333}
+ form{display:flex;gap:8px;margin-top:8px}
+ input{flex:1;background:#0d1117;border:1px solid #30363d;color:#e6edf3;
+       padding:8px;border-radius:6px}
+ button{background:#238636;color:#fff;border:0;padding:8px 16px;border-radius:6px}
+ #chat{height:220px;overflow-y:auto;font-size:13px}
+ #chat p{margin:4px 0}.role{color:#7d8590}
+ #stats{font-size:13px;line-height:1.8}
+</style></head><body>
+<header><h1>aiOS-TPU — orchestrator console</h1></header>
+<main>
+ <section><h2>Submit goal / chat</h2>
+  <div id="chat"></div>
+  <form onsubmit="return send(event)">
+   <input id="msg" placeholder="Describe a goal..." autocomplete="off">
+   <button>Send</button></form>
+ </section>
+ <section><h2>System</h2><div id="stats">loading…</div></section>
+ <section><h2>Goals</h2><div id="goals"></div></section>
+ <section><h2>Agents</h2><div id="agents"></div></section>
+</main>
+<script>
+async function refresh(){
+ const s=await (await fetch('/api/status')).json();
+ document.getElementById('stats').innerHTML=
+  `goals: ${s.active_goals} active · tasks pending: ${s.pending_tasks}`+
+  `<br>agents: ${s.active_agents} · models: ${s.loaded_models.join(', ')||'none'}`+
+  `<br>cpu: ${s.cpu_percent.toFixed(0)}% · mem: ${(s.memory_used_mb/1024).toFixed(1)}GB`+
+  `<br>uptime: ${s.uptime_seconds}s`;
+ const gs=await (await fetch('/api/goals')).json();
+ document.getElementById('goals').innerHTML=gs.goals.slice(0,12).map(g=>
+  `<div>${g.description.slice(0,60)}<span class="status ${g.status}">${g.status}</span></div>`).join('');
+ const ag=await (await fetch('/api/agents')).json();
+ document.getElementById('agents').innerHTML=ag.agents.map(a=>
+  `<div>${a.agent_id}<span class="status">${a.status}</span></div>`).join('')||'none';
+}
+async function send(e){
+ e.preventDefault();
+ const input=document.getElementById('msg');
+ const text=input.value.trim(); if(!text)return false; input.value='';
+ chatAdd('you',text);
+ const r=await (await fetch('/api/chat',{method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({message:text})})).json();
+ chatAdd('aios',r.reply);
+ refresh(); return false;
+}
+function chatAdd(role,text){
+ const c=document.getElementById('chat');
+ c.innerHTML+=`<p><span class="role">${role}:</span> ${text}</p>`;
+ c.scrollTop=c.scrollHeight;
+}
+refresh(); setInterval(refresh,3000);
+try{
+ const ws=new WebSocket(`ws://${location.host}/ws`);
+ ws.onmessage=(m)=>{refresh();};
+}catch(e){}
+</script></body></html>
+"""
+
+
+class ManagementConsole:
+    def __init__(self, orchestrator, host: str = "127.0.0.1", port: int = 9090):
+        """``orchestrator`` is an OrchestratorService (shared state)."""
+        self.orch = orchestrator
+        self.host = host
+        self.port = port
+        self._ws_clients: Set[web.WebSocketResponse] = set()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.bound_port: Optional[int] = None
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _index(self, request):
+        return web.Response(text=DASHBOARD_HTML, content_type="text/html")
+
+    async def _status(self, request):
+        engine = self.orch.engine
+        import psutil
+
+        vm = psutil.virtual_memory()
+        return web.json_response(
+            {
+                "active_goals": len(engine.active_goals()),
+                "pending_tasks": len(engine.unblocked_pending_tasks(limit=1000)),
+                "active_agents": sum(
+                    1 for a in self.orch.router.agents() if a.alive
+                ),
+                "loaded_models": list(self.orch.loaded_models()),
+                "cpu_percent": psutil.cpu_percent(interval=None),
+                "memory_used_mb": vm.used / 1e6,
+                "memory_total_mb": vm.total / 1e6,
+                "uptime_seconds": int(time.time() - self.orch.started_at),
+            }
+        )
+
+    async def _goals(self, request):
+        goals = self.orch.engine.list_goals(limit=100)
+        return web.json_response(
+            {
+                "goals": [
+                    {
+                        "id": g.id,
+                        "description": g.description,
+                        "status": g.status,
+                        "priority": g.priority,
+                        "progress": self.orch.engine.progress(g.id),
+                        "created_at": g.created_at,
+                    }
+                    for g in goals
+                ]
+            }
+        )
+
+    async def _submit_goal(self, request):
+        body = await request.json()
+        goal = self.orch.engine.submit_goal(
+            body.get("description", ""),
+            priority=int(body.get("priority", 5)),
+            source="console",
+        )
+        await self._broadcast({"event": "goal_submitted", "goal_id": goal.id})
+        return web.json_response({"goal_id": goal.id})
+
+    async def _goal_tasks(self, request):
+        goal_id = request.match_info["goal_id"]
+        tasks = self.orch.engine.tasks_for_goal(goal_id)
+        return web.json_response(
+            {
+                "tasks": [
+                    {
+                        "id": t.id,
+                        "description": t.description,
+                        "status": t.status,
+                        "agent": t.assigned_agent,
+                        "error": t.error,
+                    }
+                    for t in tasks
+                ]
+            }
+        )
+
+    async def _goal_messages(self, request):
+        goal_id = request.match_info["goal_id"]
+        msgs = self.orch.engine.messages_for_goal(goal_id)
+        return web.json_response(
+            {
+                "messages": [
+                    {"role": m.role, "content": m.content,
+                     "timestamp": m.timestamp}
+                    for m in msgs
+                ]
+            }
+        )
+
+    async def _chat(self, request):
+        body = await request.json()
+        text = body.get("message", "").strip()
+        if not text:
+            return web.json_response({"error": "empty message"}, status=400)
+        goal = self.orch.engine.submit_goal(text, source="chat")
+        self.orch.engine.add_message(goal.id, "user", text)
+        await self._broadcast({"event": "goal_submitted", "goal_id": goal.id})
+        return web.json_response(
+            {
+                "goal_id": goal.id,
+                "reply": f"Goal accepted ({goal.id[:8]}). I'll work on it.",
+            }
+        )
+
+    async def _agents(self, request):
+        return web.json_response(
+            {
+                "agents": [
+                    {
+                        "agent_id": a.agent_id,
+                        "agent_type": a.agent_type,
+                        "status": a.status if a.alive else "dead",
+                        "tasks_completed": a.tasks_completed,
+                    }
+                    for a in self.orch.router.agents()
+                ]
+            }
+        )
+
+    async def _health(self, request):
+        return web.json_response({"healthy": True, "service": "orchestrator"})
+
+    async def _ws(self, request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        self._ws_clients.add(ws)
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    try:
+                        data = json.loads(msg.data)
+                    except ValueError:
+                        continue
+                    if data.get("action") == "subscribe_goal":
+                        goal_id = data.get("goal_id", "")
+                        goal = self.orch.engine.goals.get(goal_id)
+                        if goal:
+                            await ws.send_json(
+                                {
+                                    "event": "goal_status",
+                                    "goal_id": goal_id,
+                                    "status": goal.status,
+                                    "progress": self.orch.engine.progress(goal_id),
+                                }
+                            )
+        finally:
+            self._ws_clients.discard(ws)
+        return ws
+
+    async def _broadcast(self, payload: dict) -> None:
+        dead = []
+        for ws in self._ws_clients:
+            try:
+                await ws.send_json(payload)
+            except Exception:  # noqa: BLE001
+                dead.append(ws)
+        for ws in dead:
+            self._ws_clients.discard(ws)
+
+    def notify(self, payload: dict) -> None:
+        """Thread-safe push to all WS clients."""
+        if self._loop is not None:
+            asyncio.run_coroutine_threadsafe(self._broadcast(payload), self._loop)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/status", self._status)
+        app.router.add_get("/api/goals", self._goals)
+        app.router.add_post("/api/goals", self._submit_goal)
+        app.router.add_get("/api/goals/{goal_id}/tasks", self._goal_tasks)
+        app.router.add_get("/api/goals/{goal_id}/messages", self._goal_messages)
+        app.router.add_post("/api/chat", self._chat)
+        app.router.add_get("/api/agents", self._agents)
+        app.router.add_get("/api/health", self._health)
+        app.router.add_get("/ws", self._ws)
+        return app
+
+    def start(self) -> None:
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                self._runner = web.AppRunner(self._build_app())
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, self.host, self.port)
+                await site.start()
+                for s in self._runner.sites:
+                    sock = s._server.sockets[0]  # noqa: SLF001
+                    self.bound_port = sock.getsockname()[1]
+                self._started.set()
+
+            self._loop.run_until_complete(boot())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, name="console", daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        async def shutdown():
+            if self._runner:
+                await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(shutdown(), self._loop).result(timeout=5)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread:
+            self._thread.join(timeout=5)

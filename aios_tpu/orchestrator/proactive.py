@@ -1,0 +1,174 @@
+"""Proactive goal generation.
+
+Reference parity (agent-core/src/proactive.rs): a 60 s loop that auto-creates
+remediation goals on CPU > 90%, memory > 85%, disk > 90%, failed agents,
+>= 6 consecutive service-health failures, TLS certs expiring within 30 days,
+and backups staler than 24 h (proactive.rs:74-200), deduplicating against
+already-active goals.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, List, Optional
+
+import psutil
+
+
+@dataclass
+class ProactiveConfig:
+    interval: float = 60.0
+    cpu_threshold: float = 90.0
+    memory_threshold: float = 85.0
+    disk_threshold: float = 90.0
+    health_failure_threshold: int = 6
+    cert_warning_days: int = 30
+    backup_max_age_hours: float = 24.0
+    cert_dir: str = "/tmp/aios/certs"
+    backup_dir: str = "/tmp/aios/backups"
+
+
+class ProactiveGenerator:
+    def __init__(
+        self,
+        submit_goal: Callable[[str, int], object],
+        active_goal_descriptions: Callable[[], List[str]],
+        health_failures: Optional[Callable[[], dict]] = None,
+        failed_agents: Optional[Callable[[], List[str]]] = None,
+        config: Optional[ProactiveConfig] = None,
+    ):
+        self.submit_goal = submit_goal
+        self.active_goal_descriptions = active_goal_descriptions
+        self.health_failures = health_failures
+        self.failed_agents = failed_agents
+        self.config = config or ProactiveConfig()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _maybe_submit(self, description: str, priority: int) -> bool:
+        """Dedupe against active goals (proactive.rs dedupe)."""
+        key = description.lower()[:40]
+        for active in self.active_goal_descriptions():
+            if key in active.lower():
+                return False
+        self.submit_goal(description, priority)
+        return True
+
+    def check_once(self) -> List[str]:
+        """One pass; returns descriptions of goals created."""
+        cfg = self.config
+        created: List[str] = []
+
+        cpu = psutil.cpu_percent(interval=None)
+        if cpu > cfg.cpu_threshold:
+            if self._maybe_submit(
+                f"Investigate and reduce high CPU usage ({cpu:.0f}%)", 8
+            ):
+                created.append("cpu")
+
+        mem = psutil.virtual_memory().percent
+        if mem > cfg.memory_threshold:
+            if self._maybe_submit(
+                f"Investigate and reduce high memory usage ({mem:.0f}%)", 8
+            ):
+                created.append("memory")
+
+        disk = psutil.disk_usage("/").percent
+        if disk > cfg.disk_threshold:
+            if self._maybe_submit(
+                f"Free disk space on / (at {disk:.0f}%)", 9
+            ):
+                created.append("disk")
+
+        if self.failed_agents is not None:
+            for agent in self.failed_agents():
+                if self._maybe_submit(
+                    f"Recover failed agent {agent}", 7
+                ):
+                    created.append(f"agent:{agent}")
+
+        if self.health_failures is not None:
+            for service, failures in self.health_failures().items():
+                if failures >= cfg.health_failure_threshold:
+                    if self._maybe_submit(
+                        f"Remediate unhealthy service {service}"
+                        f" ({failures} consecutive failures)", 9
+                    ):
+                        created.append(f"service:{service}")
+
+        created.extend(self._check_certs())
+        created.extend(self._check_backups())
+        return created
+
+    def _check_certs(self) -> List[str]:
+        created = []
+        cert_dir = Path(self.config.cert_dir)
+        if not cert_dir.is_dir():
+            return created
+        for cert in cert_dir.glob("*.crt"):
+            days = cert_expiry_days(str(cert))
+            if days is not None and days < self.config.cert_warning_days:
+                if self._maybe_submit(
+                    f"Rotate TLS certificate {cert.name}"
+                    f" (expires in {days} days)", 6
+                ):
+                    created.append(f"cert:{cert.name}")
+        return created
+
+    def _check_backups(self) -> List[str]:
+        backup_dir = Path(self.config.backup_dir)
+        if not backup_dir.is_dir():
+            return []
+        newest = 0.0
+        for f in backup_dir.iterdir():
+            try:
+                newest = max(newest, f.stat().st_mtime)
+            except OSError:
+                continue
+        if newest == 0.0:
+            return []
+        age_hours = (time.time() - newest) / 3600
+        if age_hours > self.config.backup_max_age_hours:
+            if self._maybe_submit(
+                f"Run system backup (last backup {age_hours:.0f}h ago)", 5
+            ):
+                return ["backup"]
+        return []
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.config.interval):
+                try:
+                    self.check_once()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        self._thread = threading.Thread(target=loop, name="proactive",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def cert_expiry_days(cert_path: str) -> Optional[int]:
+    """Days until a PEM cert expires (openssl-based; rcgen in the reference)."""
+    try:
+        out = subprocess.run(
+            ["openssl", "x509", "-enddate", "-noout", "-in", cert_path],
+            capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode != 0:
+            return None
+        # notAfter=Jan  1 00:00:00 2027 GMT
+        raw = out.stdout.strip().split("=", 1)[1]
+        expiry = time.mktime(time.strptime(raw, "%b %d %H:%M:%S %Y %Z"))
+        return int((expiry - time.time()) / 86400)
+    except (OSError, ValueError, IndexError):
+        return None

@@ -1,0 +1,170 @@
+"""Orchestrator gRPC surface + management console over live sockets."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from aios_tpu import rpc, services
+from aios_tpu.orchestrator.management import ManagementConsole
+from aios_tpu.orchestrator.service import OrchestratorService, serve
+from aios_tpu.proto_gen import common_pb2, orchestrator_pb2
+
+
+@pytest.fixture(scope="module")
+def orch():
+    server, service, port = serve(address="127.0.0.1:0", block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    yield services.OrchestratorStub(channel), service
+    channel.close()
+    server.stop(grace=None)
+
+
+def test_goal_submit_status_cancel(orch):
+    stub, service = orch
+    gid = stub.SubmitGoal(
+        orchestrator_pb2.SubmitGoalRequest(
+            description="check disk usage", priority=6, source="test"
+        )
+    )
+    assert gid.id
+    status = stub.GetGoalStatus(common_pb2.GoalId(id=gid.id))
+    assert status.goal.description == "check disk usage"
+    goals = stub.ListGoals(orchestrator_pb2.ListGoalsRequest())
+    assert goals.total >= 1
+    cancelled = stub.CancelGoal(common_pb2.GoalId(id=gid.id))
+    assert cancelled.success
+
+
+def test_agent_register_poll_report_cycle(orch):
+    stub, service = orch
+    stub.RegisterAgent(common_pb2.AgentRegistration(
+        agent_id="system_agent-t1",
+        agent_type="system",
+        tool_namespaces=["service", "monitor"],
+    ))
+    hb = stub.Heartbeat(orchestrator_pb2.HeartbeatRequest(
+        agent_id="system_agent-t1", status="idle"))
+    assert hb.success
+    agents = stub.ListAgents(common_pb2.Empty())
+    assert any(a.agent_id == "system_agent-t1" for a in agents.agents)
+
+    # plant a routed task and poll it back
+    gid = stub.SubmitGoal(orchestrator_pb2.SubmitGoalRequest(
+        description="restart the cron service"))
+    from aios_tpu.orchestrator.goal_engine import Task
+
+    t = Task(id="tt-1", goal_id=gid.id, description="restart cron",
+             required_tools=["service"])
+    service.engine.add_tasks(gid.id, [t])
+    assert service.router.route_task(t) == "system_agent-t1"
+
+    polled = stub.GetAssignedTask(common_pb2.AgentId(id="system_agent-t1"))
+    assert polled.id == "tt-1"
+    report = stub.ReportTaskResult(common_pb2.TaskResult(
+        task_id="tt-1", success=True,
+        output_json=json.dumps({"restarted": True}).encode(),
+        duration_ms=42, model_used="none",
+    ))
+    assert report.success
+    assert service.engine.tasks["tt-1"].status == "completed"
+    assert service.aggregator.summary(gid.id).succeeded == 1
+
+
+def test_empty_poll_returns_empty_task(orch):
+    stub, _ = orch
+    polled = stub.GetAssignedTask(common_pb2.AgentId(id="system_agent-t1"))
+    assert polled.id == ""
+
+
+def test_capability_auto_grant_quirk(orch):
+    stub, _ = orch
+    resp = stub.RequestCapability(orchestrator_pb2.CapabilityRequest(
+        agent_id="x", capabilities=["fs.write", "sec.admin"]))
+    assert resp.granted  # reference auto-grants everything (main.rs:395-411)
+    assert list(resp.capabilities) == ["fs.write", "sec.admin"]
+
+
+def test_schedules_actually_wired(orch):
+    stub, _ = orch
+    created = stub.CreateSchedule(orchestrator_pb2.CreateScheduleRequest(
+        cron_expr="0 3 * * *", goal_template="nightly backup", priority=4))
+    assert created.success
+    listed = stub.ListSchedules(common_pb2.Empty())
+    assert any(s.goal_template == "nightly backup" for s in listed.schedules)
+    deleted = stub.DeleteSchedule(orchestrator_pb2.DeleteScheduleRequest(
+        schedule_id=created.schedule_id))
+    assert deleted.success
+
+
+def test_cluster_node_rpcs(orch):
+    stub, _ = orch
+    reg = stub.RegisterNode(orchestrator_pb2.NodeRegistration(
+        node_id="node-b", hostname="b", address="10.0.0.2:50051",
+        max_tasks=5))
+    assert reg.success
+    hb = stub.NodeHeartbeat(orchestrator_pb2.NodeStatus(
+        node_id="node-b", cpu_usage=12.5, active_tasks=1))
+    assert hb.success
+    nodes = stub.ListNodes(orchestrator_pb2.ListNodesRequest())
+    assert nodes.nodes[0].node_id == "node-b"
+    assert nodes.nodes[0].healthy
+
+
+def test_system_status(orch):
+    stub, _ = orch
+    s = stub.GetSystemStatus(common_pb2.Empty())
+    assert s.memory_total_mb > 0
+    assert s.uptime_seconds >= 0
+
+
+# ---------------------------------------------------------------------------
+# Management console
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def console(orch):
+    _, service = orch
+    c = ManagementConsole(service, port=0)
+    c.start()
+    yield f"http://127.0.0.1:{c.bound_port}"
+    c.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def test_console_dashboard_and_api(console):
+    with urllib.request.urlopen(console + "/", timeout=5) as r:
+        html = r.read().decode()
+    assert "aiOS-TPU" in html and "<script>" in html
+
+    health = _get(console + "/api/health")
+    assert health["healthy"]
+
+    status = _get(console + "/api/status")
+    assert "active_goals" in status
+
+    out = _post(console + "/api/chat", {"message": "check cpu please"})
+    assert out["goal_id"]
+    goals = _get(console + "/api/goals")
+    assert any(g["id"] == out["goal_id"] for g in goals["goals"])
+    msgs = _get(console + f"/api/goals/{out['goal_id']}/messages")
+    assert msgs["messages"][0]["content"] == "check cpu please"
+    tasks = _get(console + f"/api/goals/{out['goal_id']}/tasks")
+    assert "tasks" in tasks
+    agents = _get(console + "/api/agents")
+    assert "agents" in agents
