@@ -1,0 +1,271 @@
+"""Agent framework: BaseAgent lifecycle + all 10 agents with mocked tools.
+
+Follows the reference's mocked-gRPC pattern (python tests conftest.py:29-37
+injects MagicMock channels; per-agent tests patch call_tool/think) — here the
+tool/think/memory layers are patched directly on the agent instances.
+"""
+
+from unittest.mock import MagicMock, patch
+
+import pytest
+
+from aios_tpu.agents import AGENT_TYPES, agent_class
+from aios_tpu.agents.base import BaseAgent
+from aios_tpu.agents.catalog import (
+    CreatorAgent,
+    MonitoringAgent,
+    NetworkAgent,
+    PackageAgent,
+    SecurityAgent,
+    StorageAgent,
+    SystemAgent,
+    TaskAgent,
+    WebAgent,
+)
+from aios_tpu.agents.spawner import AgentConfig, load_agent_configs
+
+
+class HarnessAgent(BaseAgent):
+    """Concrete subclass exercising the ABC (test_base_agent.py:26 style)."""
+
+    def get_agent_type(self):
+        return "system"
+
+    def get_capabilities(self):
+        return ["monitor.read"]
+
+    def get_tool_namespaces(self):
+        return ["monitor"]
+
+    def handle_task(self, task):
+        if "explode" in task["description"]:
+            raise RuntimeError("kaboom")
+        return {"handled": task["description"]}
+
+
+def _tool_ok(output=None):
+    def call_tool(tool, args=None, reason=""):
+        return {"success": True, "output": output or {"tool": tool},
+                "error": "", "execution_id": "e1"}
+
+    return call_tool
+
+
+# ---------------------------------------------------------------------------
+# BaseAgent
+# ---------------------------------------------------------------------------
+
+
+def test_execute_task_bookkeeping():
+    a = HarnessAgent(name="t-1")
+    ok = a.execute_task({"id": "x", "description": "do a thing",
+                         "input": {}})
+    assert ok["success"] and ok["output"] == {"handled": "do a thing"}
+    assert a.tasks_completed == 1 and a.status == "idle"
+
+    bad = a.execute_task({"id": "y", "description": "explode now",
+                          "input": {}})
+    assert not bad["success"] and "kaboom" in bad["error"]
+    assert a.tasks_failed == 1
+
+
+def test_agent_ids_and_types():
+    for atype in AGENT_TYPES:
+        cls = agent_class(atype)
+        agent = cls()
+        assert agent.get_agent_type() == atype
+        assert agent.agent_id.startswith(f"{atype}_agent-")
+        assert agent.get_tool_namespaces()
+        agent_class(atype)  # idempotent resolution
+
+
+def test_all_ten_agent_types_exist():
+    assert len(AGENT_TYPES) == 10  # reference has 10 (not the README's 8)
+
+
+# ---------------------------------------------------------------------------
+# Individual agents (mocked tool layer)
+# ---------------------------------------------------------------------------
+
+
+def test_system_agent_restart_flow():
+    a = SystemAgent(name="sys-t")
+    calls = []
+
+    def call_tool(tool, args=None, reason=""):
+        calls.append(tool)
+        return {"success": True, "output": {"state": "active"}, "error": ""}
+
+    a.call_tool = call_tool
+    out = a.handle_task({"id": "t", "description": "restart the nginx service",
+                         "input": {}})
+    assert calls == ["service.status", "service.restart", "service.status"]
+    assert out["service"] == "nginx"
+
+
+def test_system_agent_restart_failure_raises():
+    a = SystemAgent(name="sys-t")
+
+    def call_tool(tool, args=None, reason=""):
+        ok = tool != "service.restart"
+        return {"success": ok, "output": {}, "error": "unit not found"}
+
+    a.call_tool = call_tool
+    with pytest.raises(RuntimeError):
+        a.handle_task({"id": "t", "description": "restart the ghost service",
+                       "input": {}})
+
+
+def test_network_agent_connectivity_probe():
+    a = NetworkAgent(name="net-t")
+    a.call_tool = _tool_ok({"reachable": True})
+    out = a.handle_task({"id": "t", "description": "check connectivity",
+                         "input": {}})
+    assert set(out["probes"]) == set(NetworkAgent.PROBE_HOSTS)
+
+
+def test_security_agent_full_sweep():
+    a = SecurityAgent(name="sec-t")
+    seen = []
+
+    def call_tool(tool, args=None, reason=""):
+        seen.append(tool)
+        return {"success": True, "output": {}, "error": ""}
+
+    a.call_tool = call_tool
+    a.handle_task({"id": "t", "description": "run a security sweep",
+                   "input": {}})
+    assert "sec.scan" in seen and "sec.scan_rootkits" in seen
+
+
+def test_package_agent_install_checks_search_first():
+    a = PackageAgent(name="pkg-t")
+    calls = []
+
+    def call_tool(tool, args=None, reason=""):
+        calls.append((tool, args))
+        if tool == "pkg.search":
+            return {"success": True, "output": {"results": ["htop - viewer"]},
+                    "error": ""}
+        return {"success": True, "output": {"installed": args["name"]},
+                "error": ""}
+
+    a.call_tool = call_tool
+    out = a.handle_task({"id": "t", "description": "install htop",
+                         "input": {}})
+    assert calls[0][0] == "pkg.search"
+    assert out["installed"] == "htop"
+
+
+def test_package_agent_install_missing_package():
+    a = PackageAgent(name="pkg-t")
+    a.call_tool = lambda tool, args=None, reason="": {
+        "success": True, "output": {"results": []}, "error": ""}
+    with pytest.raises(RuntimeError):
+        a.handle_task({"id": "t", "description": "install doesnotexist",
+                       "input": {}})
+
+
+def test_monitoring_agent_anomaly_detection():
+    a = MonitoringAgent(name="mon-t")
+    for _ in range(50):
+        assert not a.observe("cpu", 20.0)
+    # flat baseline then a huge spike -> anomaly
+    assert a.observe("cpu", 99.0)
+    assert not a.observe("cpu", 20.5)
+
+
+def test_learning_agent_stores_recurring_patterns():
+    a = agent_class("learning")(name="learn-t")
+    a.get_recent_events = lambda count=100: (
+        [{"category": "disk.full", "source": "x", "data": {}, "timestamp": 0}] * 6
+        + [{"category": "rare.event", "source": "x", "data": {}, "timestamp": 0}]
+    )
+    stored = []
+    a.store_pattern = lambda trigger, action, success_rate=1.0: stored.append(trigger)
+    a.update_metric = lambda k, v: None
+    out = a.learn_cycle()
+    assert stored == ["disk.full"]
+    assert out["recurring"]["disk.full"] == 6
+
+
+def test_storage_agent_backup():
+    a = StorageAgent(name="sto-t")
+    a.call_tool = _tool_ok()
+    out = a.handle_task({"id": "t", "description": "backup the config",
+                         "input": {"src": "/etc/x", "dst": "/tmp/y"}})
+    assert out["backed_up"] == "/etc/x"
+
+
+def test_task_agent_plans_with_think():
+    a = TaskAgent(name="task-t")
+    a.assemble_context = lambda d, max_tokens=512: "ctx"
+    a.think = lambda prompt, level="operational", max_tokens=512: (
+        '[{"tool": "monitor.cpu", "args": {}}, {"tool": "fs.list", "args": {"path": "/tmp"}}]'
+    )
+    executed = []
+
+    def call_tool(tool, args=None, reason=""):
+        executed.append(tool)
+        return {"success": True, "output": {}, "error": ""}
+
+    a.call_tool = call_tool
+    out = a.handle_task({"id": "t", "description": "summarize the system",
+                         "input": {}, "intelligence_level": "tactical"})
+    assert executed == ["monitor.cpu", "fs.list"]
+    assert len(out["steps"]) == 2
+
+
+def test_web_agent_scrape_requires_url():
+    a = WebAgent(name="web-t")
+    a.call_tool = _tool_ok({"text": "page text"})
+    out = a.handle_task({
+        "id": "t",
+        "description": "scrape https://example.com/docs please",
+        "input": {},
+    })
+    assert out["text"] == "page text"
+    with pytest.raises(ValueError):
+        a.handle_task({"id": "t", "description": "scrape the page",
+                       "input": {}})
+
+
+def test_creator_agent_scaffold_and_git():
+    a = CreatorAgent(name="cre-t")
+    calls = []
+
+    def call_tool(tool, args=None, reason=""):
+        calls.append(tool)
+        return {"success": True,
+                "output": {"files": ["/tmp/aios/projects/p/main.py"]},
+                "error": ""}
+
+    a.call_tool = call_tool
+    out = a.handle_task({"id": "t", "description": "create a new project",
+                         "input": {"name": "p"}})
+    assert calls == ["code.scaffold", "git.init"]
+    assert out["git"] == "initialized"
+
+
+# ---------------------------------------------------------------------------
+# Spawner configs
+# ---------------------------------------------------------------------------
+
+
+def test_spawner_default_configs(tmp_path):
+    configs = load_agent_configs(str(tmp_path / "missing"))
+    assert [c.agent_type for c in configs] == ["system", "network", "security"]
+
+
+def test_spawner_toml_configs(tmp_path):
+    (tmp_path / "monitoring.toml").write_text(
+        '[agent]\nname = "mon-main"\ntype = "monitoring"\nenabled = true\n'
+    )
+    (tmp_path / "web.toml").write_text(
+        '[agent]\ntype = "web"\nenabled = false\n'
+    )
+    (tmp_path / "bogus.toml").write_text('[agent]\ntype = "nonexistent"\n')
+    configs = load_agent_configs(str(tmp_path))
+    assert len(configs) == 1
+    assert configs[0].name == "mon-main"
+    assert configs[0].agent_type == "monitoring"
