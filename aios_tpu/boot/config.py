@@ -1,0 +1,116 @@
+"""Layered TOML configuration.
+
+Reference parity (initd/src/config.rs:14-34 + config/default-config.toml):
+the 9-section schema — system / boot / models / api / memory / security /
+networking / agents / monitoring — loaded from /etc/aios/config.toml with
+full defaults when the file is absent, plus env-var overrides for service
+addresses (handled in aios_tpu.services) and model/runtime knobs.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List
+
+DEFAULT_CONFIG_PATH = "/etc/aios/config.toml"
+
+
+def _default_sections() -> Dict[str, Dict[str, Any]]:
+    return {
+        "system": {
+            "hostname": "aios-tpu",
+            "log_level": "info",
+            "data_dir": "/tmp/aios",
+        },
+        "boot": {
+            "health_timeout_seconds": 60,
+            "max_restart_attempts": 5,
+            "restart_window_seconds": 300,
+            "emergency_shell": False,
+        },
+        "models": {
+            "model_dir": "/var/lib/aios/models",
+            "default_context": 4096,
+            "num_slots": 8,
+            "warm_compile": True,
+            "autoload": True,
+        },
+        "api": {
+            "claude_model": "claude-sonnet-4-20250514",
+            "openai_model": "gpt-5",
+            "qwen3_model": "qwen3:30b-128k",
+            "claude_monthly_budget": 100.0,
+            "openai_monthly_budget": 50.0,
+        },
+        "memory": {
+            "operational_capacity": 10000,
+            "working_retention_days": 30,
+            "longterm_retention_days": 365,
+            "migration_interval_seconds": 300,
+        },
+        "security": {
+            "audit_db": "/tmp/aios/ledger/audit.db",
+            "cert_dir": "/tmp/aios/certs",
+            "secrets_path": "/etc/aios/secrets.toml",
+            "sandbox_memory_mb": 256,
+        },
+        "networking": {
+            "bind_host": "127.0.0.1",
+            "console_port": 9090,
+            "cluster_enabled": False,
+        },
+        "agents": {
+            "config_dir": "/etc/aios/agents",
+            "default_agents": ["system", "network", "security"],
+            "max_restart_attempts": 5,
+            "heartbeat_seconds": 10,
+            "poll_seconds": 2,
+        },
+        "monitoring": {
+            "proactive_interval_seconds": 60,
+            "cpu_threshold": 90.0,
+            "memory_threshold": 85.0,
+            "disk_threshold": 90.0,
+        },
+    }
+
+
+@dataclass
+class AiosConfig:
+    sections: Dict[str, Dict[str, Any]] = field(default_factory=_default_sections)
+    source_path: str = ""
+
+    def get(self, section: str, key: str, default: Any = None) -> Any:
+        return self.sections.get(section, {}).get(key, default)
+
+    def section(self, name: str) -> Dict[str, Any]:
+        return dict(self.sections.get(name, {}))
+
+    @property
+    def data_dir(self) -> str:
+        return os.environ.get("AIOS_DATA_DIR") or self.get(
+            "system", "data_dir", "/tmp/aios"
+        )
+
+
+def load_config(path: str | None = None) -> AiosConfig:
+    """Defaults deep-merged with the TOML file when present."""
+    path = path or os.environ.get("AIOS_CONFIG", DEFAULT_CONFIG_PATH)
+    sections = _default_sections()
+    source = ""
+    p = Path(path)
+    if p.is_file():
+        try:
+            loaded = tomllib.loads(p.read_text())
+            for name, values in loaded.items():
+                if isinstance(values, dict):
+                    sections.setdefault(name, {}).update(values)
+                else:
+                    sections.setdefault("system", {})[name] = values
+            source = str(p)
+        except (OSError, ValueError):
+            pass
+    return AiosConfig(sections=sections, source_path=source)

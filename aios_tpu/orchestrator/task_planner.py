@@ -84,11 +84,18 @@ _TOOL_KEYWORDS = [
 
 
 def infer_required_tools(description: str) -> List[str]:
-    """Keyword -> tool-namespace map (task_planner.rs:601-676)."""
+    """Keyword -> tool-namespace map (task_planner.rs:601-676).
+
+    Whole-word matching: plain substring matching misfires ("port" inside
+    "report", "install" inside "reinstallation").
+    """
     low = description.lower()
     namespaces = []
     for keywords, namespace in _TOOL_KEYWORDS:
-        if any(k in low for k in keywords) and namespace not in namespaces:
+        hit = any(
+            re.search(r"\b" + re.escape(k) + r"\b", low) for k in keywords
+        )
+        if hit and namespace not in namespaces:
             namespaces.append(namespace)
     return namespaces
 
