@@ -1,0 +1,26 @@
+"""Build the native shared library with g++ (no cmake needed for one TU)."""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+HERE = Path(__file__).parent
+SRC = HERE / "src" / "aios_native.cpp"
+OUT = HERE / "libaios_native.so"
+
+
+def build(force: bool = False) -> Path:
+    if OUT.exists() and not force:
+        if OUT.stat().st_mtime >= SRC.stat().st_mtime:
+            return OUT
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-o", str(OUT), str(SRC),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build(force=True))

@@ -33,18 +33,30 @@ class TokenBucket:
             return False
 
 
+def _make_bucket(rate: float):
+    """Native C++ bucket when the library is built, Python otherwise."""
+    try:
+        from .. import native
+
+        if native.available():
+            return native.NativeTokenBucket(rate)
+    except Exception:  # noqa: BLE001 — fall back silently
+        pass
+    return TokenBucket(rate)
+
+
 class RateLimiter:
     def __init__(self, agent_rps: float = AGENT_RPS, tool_rps: float = TOOL_RPS):
         self.agent_rps = agent_rps
         self.tool_rps = tool_rps
-        self._agents: Dict[str, TokenBucket] = {}
-        self._tools: Dict[str, TokenBucket] = {}
+        self._agents: Dict[str, object] = {}
+        self._tools: Dict[str, object] = {}
         self._lock = threading.Lock()
 
     def check(self, agent_id: str, tool_name: str) -> tuple[bool, str]:
         with self._lock:
-            ab = self._agents.setdefault(agent_id, TokenBucket(self.agent_rps))
-            tb = self._tools.setdefault(tool_name, TokenBucket(self.tool_rps))
+            ab = self._agents.setdefault(agent_id, _make_bucket(self.agent_rps))
+            tb = self._tools.setdefault(tool_name, _make_bucket(self.tool_rps))
         if not ab.try_acquire():
             return False, f"agent {agent_id} rate limit exceeded ({self.agent_rps}/s)"
         if not tb.try_acquire():
