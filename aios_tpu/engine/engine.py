@@ -54,6 +54,7 @@ class TPUEngine:
         cache_dtype=jnp.bfloat16,
         seed: int = 0,
         shardings=None,  # optional ShardingPlan (aios_tpu.parallel.sharding)
+        quantize: bool = False,  # int8 serving weights (single-chip path)
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -63,11 +64,21 @@ class TPUEngine:
         ) or (self.max_context,)
         self._lock = threading.Lock()
         self.plan = shardings
+        self.quantized = bool(quantize)
+        # Pallas kernels are per-device programs; under a sharding plan the
+        # global-array paths must stay pure XLA (GSPMD partitions those).
+        self._kernels: Optional[bool] = False if shardings is not None else None
 
         if shardings is not None:
+            if quantize:
+                raise NotImplementedError(
+                    "int8 serving weights are single-chip for now"
+                )
             self.params = shardings.put_params(params)
         else:
             self.params = jax.tree.map(jnp.asarray, params)
+            if quantize:
+                self.params = model.quantize_params(self.params)
 
         k, v = model.init_kv_cache(cfg, num_slots, self.max_context, cache_dtype)
         if shardings is not None:
@@ -97,7 +108,13 @@ class TPUEngine:
             st = carry
             key, sub = jax.random.split(st["key"])
             logits, k, v = model.decode_step(
-                params, self.cfg, st["last_tokens"], st["lengths"], st["k"], st["v"]
+                params,
+                self.cfg,
+                st["last_tokens"],
+                st["lengths"],
+                st["k"],
+                st["v"],
+                kernels=self._kernels,
             )
             next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
             st = {
@@ -117,7 +134,9 @@ class TPUEngine:
     def _prefill_impl(
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p
     ):
-        logits, ks, vs = model.prefill(params, self.cfg, tokens)
+        logits, ks, vs = model.prefill(
+            params, self.cfg, tokens, kernels=self._kernels
+        )
         start = (0, slot, 0, 0, 0)
         k = jax.lax.dynamic_update_slice(
             state["k"], ks.astype(state["k"].dtype), start

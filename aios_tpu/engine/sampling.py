@@ -40,21 +40,45 @@ def top_k_filter(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(logits >= threshold, logits, -jnp.inf)
 
 
+# Candidate pool for the decode-loop sampler. A full-vocab sort per step is
+# the naive approach and measurably slow on TPU; restricting top-p to the 64
+# highest logits matches llama.cpp's own sampler chain, which applies
+# top-k 40 *before* top-p by default (the reference sends temperature only,
+# inference.rs:103-112, so llama-server uses those defaults).
+TOPK_CAP = 64
+
+
 def sample(
     logits: jnp.ndarray,  # [B, V] fp32
     key: jax.Array,
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B], 1.0 disables
-    top_k: jnp.ndarray | None = None,  # [B] int32, 0 disables
+    top_k: jnp.ndarray | None = None,  # [B] int32; 0 => the TOPK_CAP pool
 ) -> jnp.ndarray:
-    """Sample one token per row; temperature < GREEDY_EPS rows take argmax."""
+    """Sample one token per row; temperature < GREEDY_EPS rows take argmax.
+
+    Nucleus + top-k filtering run on the TOPK_CAP highest logits via
+    ``lax.top_k`` — no full-vocab sort in the decode graph. Consequently the
+    candidate pool is capped at TOPK_CAP: top_k values above it (or 0,
+    "disabled") sample from the best TOPK_CAP tokens, and top-p mass beyond
+    them is truncated — matching llama-server, whose default chain applies
+    top-k 40 before top-p.
+    """
+    B, V = logits.shape
+    K = min(TOPK_CAP, V)
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(temperature, GREEDY_EPS)[:, None]
-    scaled = logits / temp
+    vals, idx = jax.lax.top_k(logits / temp, K)  # [B, K] sorted desc
     if top_k is not None:
-        scaled = top_k_filter(scaled, top_k)
-    scaled = top_p_filter(scaled, top_p)
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+        kk = jnp.where(top_k <= 0, K, jnp.minimum(top_k, K))
+        pos = jnp.arange(K)[None, :]
+        vals = jnp.where(pos < kk[:, None], vals, -jnp.inf)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    keep = (cumulative - probs) < top_p[:, None]
+    vals = jnp.where(keep, vals, -jnp.inf)
+    choice = jax.random.categorical(key, vals, axis=-1)  # [B] in [0, K)
+    sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
 
     return jnp.where(temperature < GREEDY_EPS, greedy, sampled).astype(jnp.int32)

@@ -1,9 +1,13 @@
 """ctypes bindings for the native C++ runtime primitives.
 
 Builds lazily (g++ via build.py) and degrades gracefully: when the shared
-library is missing or the toolchain is absent, `available()` is False and the
-pure-Python implementations in tools/ratelimit.py and memory/tiers.py are
-used instead — same semantics, native speed when present.
+library is missing or the toolchain is absent, `available()` is False and
+callers fall back to their pure-Python implementations — same semantics,
+native speed when present. Wired consumers: the tool-registry rate limiter
+(tools/ratelimit.py, NativeTokenBucket) and the audit ledger's record hash
+(tools/audit.py, sha256_hex). NativeRing and chain_hash are standalone
+primitives with parity tests; the memory service's operational ring keeps
+its Python deque because its queries filter on event dict fields.
 """
 
 from __future__ import annotations
@@ -32,7 +36,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.aios_ring_size.argtypes = [ctypes.c_void_p]
     lib.aios_ring_total.restype = ctypes.c_uint64
     lib.aios_ring_total.argtypes = [ctypes.c_void_p]
-    lib.aios_ring_get_recent.restype = ctypes.c_uint64
+    lib.aios_ring_get_recent.restype = ctypes.c_int64  # -1 = index absent
     lib.aios_ring_get_recent.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                          u8p, ctypes.c_uint64]
     lib.aios_bucket_create.restype = ctypes.c_void_p
@@ -120,7 +124,7 @@ class NativeRing:
         u8 = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
         for i in range(count):
             n = self._lib.aios_ring_get_recent(self._handle, i, u8, len(buf))
-            if n == 0:
+            if n < 0:  # index beyond ring (0 is a valid empty item)
                 break
             if n > len(buf):  # grow and retry
                 buf = ctypes.create_string_buffer(int(n))

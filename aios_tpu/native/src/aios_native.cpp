@@ -192,15 +192,18 @@ uint64_t aios_ring_total(void* handle) {
 
 // Copy the i-th most recent item (0 = newest) into out; returns its length,
 // 0 if absent, or the required size if out_cap is too small.
-uint64_t aios_ring_get_recent(void* handle, uint64_t index, uint8_t* out,
-                              uint64_t out_cap) {
+// Returns the item's size (0 is a valid empty item; copy happens only when
+// it fits out_cap) or -1 when `index` is beyond the ring — a distinct
+// sentinel so empty events are not mistaken for end-of-ring.
+int64_t aios_ring_get_recent(void* handle, uint64_t index, uint8_t* out,
+                             uint64_t out_cap) {
   Ring* r = static_cast<Ring*>(handle);
   std::lock_guard<std::mutex> lock(r->mu);
-  if (index >= r->items.size()) return 0;
+  if (index >= r->items.size()) return -1;
   const auto& item = r->items[r->items.size() - 1 - index];
-  if (item.size() > out_cap) return item.size();
+  if (item.size() > out_cap) return static_cast<int64_t>(item.size());
   memcpy(out, item.data(), item.size());
-  return item.size();
+  return static_cast<int64_t>(item.size());
 }
 
 // ---------------------------------------------------------------------------

@@ -1,0 +1,67 @@
+"""Pallas TPU kernels for the hot ops of the decode/prefill path.
+
+The reference has no in-tree kernels at all — it shells out to llama.cpp
+(SURVEY.md section 2.3, runtime/src/model_manager.rs:187-204). Here the hot
+loops are owned by this package:
+
+  * ``flash_attention`` — blockwise causal attention for prefill/training.
+    Never materializes the [T, S] score matrix, which is what makes 8k+
+    contexts fit in a single chip's HBM (a naive prefill at T=8192 would
+    allocate ~8.6 GB of fp32 scores per layer).
+  * ``decode_attention`` — ragged batched-decode attention over the slot KV
+    cache. Manually DMAs only the valid rows [0, length] of each slot from
+    HBM (double-buffered), so short sequences don't pay full-context
+    bandwidth.
+  * ``quantized_matmul`` — int8-weight x bf16-activation matmul with
+    per-output-channel scales; weights stream from HBM as int8 (half the
+    bytes of bf16), dequantized in VMEM right before hitting the MXU.
+
+Every kernel has a pure-jnp reference implementation used (a) as the CPU
+fallback so the whole framework runs anywhere, and (b) as the ground truth
+for numeric parity tests (kernels additionally run under
+``pltpu.force_tpu_interpret_mode`` on CPU in tests).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+
+from .decode_attention import decode_attention, decode_attention_reference
+from .flash_attention import flash_attention, flash_attention_reference
+from .quantized_matmul import (
+    dequantize,
+    quantize_int8,
+    quantized_matmul,
+    quantized_matmul_reference,
+)
+
+__all__ = [
+    "flash_attention",
+    "flash_attention_reference",
+    "decode_attention",
+    "decode_attention_reference",
+    "quantize_int8",
+    "dequantize",
+    "quantized_matmul",
+    "quantized_matmul_reference",
+    "use_pallas",
+]
+
+
+@lru_cache(maxsize=1)
+def use_pallas() -> bool:
+    """True when the Pallas kernel path should be used.
+
+    On TPU backends the kernels are the default; ``AIOS_TPU_NO_PALLAS=1``
+    forces the jnp reference path (debugging / A-B benchmarking). Non-TPU
+    backends always take the reference path — the kernels are Mosaic-only.
+    """
+    if os.environ.get("AIOS_TPU_NO_PALLAS", "").lower() in ("1", "true"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

@@ -1,0 +1,227 @@
+"""Ragged batched-decode attention over the slot KV cache.
+
+The decode hot loop attends one new query token per slot against that slot's
+cache rows [0, length]. A naive XLA implementation reads the *entire*
+[C, KH, D] cache for every slot every step; this kernel instead DMAs only
+the blocks that contain valid rows (double-buffered HBM→VMEM, overlapping
+copy with compute), so a slot that is 100 tokens into a 8192-row cache reads
+~1% of the naive bandwidth. Sliding-window models additionally skip blocks
+below the window start.
+
+Layout: caches stay exactly as the engine stores them — [B, C, KH, D]
+reshaped (free) to [B, C, KH*D] so VMEM tiles are lane-aligned. Grid is
+(B,); each program owns one slot and runs the online-softmax recurrence over
+its kv blocks with per-kv-head MXU dots.
+
+This is the TPU-native replacement for the per-request attention inside
+llama.cpp's decode loop (SURVEY.md section 2.3 / section 3.2 "THE hot loop").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    len_ref,  # SMEM [B] int32
+    q_ref,  # VMEM [1, H, D]
+    k_hbm,  # ANY  [B, C, KH*D]
+    v_hbm,  # ANY  [B, C, KH*D]
+    o_ref,  # VMEM [1, H, D]
+    *,
+    num_kv_heads: int,
+    head_dim: int,
+    block_kv: int,
+    window: Optional[int],
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    KH, D, bk = num_kv_heads, head_dim, block_kv
+    H = q_ref.shape[1]
+    G = H // KH
+
+    length = len_ref[b]  # row `length` holds the just-written token
+    total = length + 1
+    n_blk = pl.cdiv(total, bk)
+    if window is not None:
+        start_blk = jnp.maximum(total - window, 0) // bk
+    else:
+        start_blk = jnp.int32(0)
+
+    q = q_ref[0] * sm_scale  # [H, D]
+
+    def body(k_buf, v_buf, sems):
+        def dma(buf_hbm, scr, slot, blk, sem_idx):
+            return pltpu.make_async_copy(
+                buf_hbm.at[b, pl.ds(blk * bk, bk)],
+                scr.at[slot],
+                sems.at[slot, sem_idx],
+            )
+
+        dma(k_hbm, k_buf, 0, start_blk, 0).start()
+        dma(v_hbm, v_buf, 0, start_blk, 1).start()
+
+        def loop(i, carry):
+            m, l, acc = carry  # [H, 1], [H, 1], [H, D] f32
+            slot = jax.lax.rem(i - start_blk, 2)
+
+            @pl.when(i + 1 < n_blk)
+            def _prefetch():
+                nxt = 1 - slot
+                dma(k_hbm, k_buf, nxt, i + 1, 0).start()
+                dma(v_hbm, v_buf, nxt, i + 1, 1).start()
+
+            dma(k_hbm, k_buf, slot, i, 0).wait()
+            dma(v_hbm, v_buf, slot, i, 1).wait()
+            kb = k_buf[slot]  # [bk, KH*D]
+            vb = v_buf[slot]
+
+            cols = i * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+            valid = cols <= length
+            if window is not None:
+                valid = jnp.logical_and(valid, cols > length - window)
+
+            # scores for all H query heads, grouped by kv head
+            parts = []
+            for h in range(KH):
+                qh = q[h * G : (h + 1) * G, :]  # [G, D]
+                kh = kb[:, h * D : (h + 1) * D]  # [bk, D]
+                parts.append(
+                    jax.lax.dot_general(
+                        qh,
+                        kh,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            s = jnp.concatenate(parts, axis=0)  # [H, bk]
+            s = jnp.where(valid, s, NEG_INF)
+
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(s - m_new)  # [H, bk]
+            p = jnp.where(valid, p, 0.0)  # fully-masked tile => p would be 1
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+            outs = []
+            pv = p.astype(vb.dtype)
+            for h in range(KH):
+                ph = pv[h * G : (h + 1) * G, :]  # [G, bk]
+                vh = vb[:, h * D : (h + 1) * D]  # [bk, D]
+                outs.append(
+                    jax.lax.dot_general(
+                        ph,
+                        vh,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            acc_new = acc * alpha + jnp.concatenate(outs, axis=0)
+            return m_new, l_new, acc_new
+
+        init = (
+            jnp.full((H, 1), NEG_INF, jnp.float32),
+            jnp.zeros((H, 1), jnp.float32),
+            jnp.zeros((H, D), jnp.float32),
+        )
+        m, l, acc = jax.lax.fori_loop(start_blk, n_blk, loop, init)
+        safe_l = jnp.where(l <= 0.0, 1.0, l)
+        o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        k_buf=pltpu.VMEM((2, bk, KH * D), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, bk, KH * D), v_hbm.dtype),
+        sems=pltpu.SemaphoreType.DMA((2, 2)),
+    )
+
+
+def pick_block_kv(C: int, preferred: int = 256) -> int:
+    """Largest power-of-two block <= preferred that divides the cache."""
+    bk = min(preferred, C)
+    while bk > 1 and C % bk:
+        bk //= 2
+    return bk
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "block_kv", "interpret")
+)
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, D] — one new query per slot
+    k_cache: jnp.ndarray,  # [B, C, KH, D]
+    v_cache: jnp.ndarray,  # [B, C, KH, D]
+    lengths: jnp.ndarray,  # [B] int32; row `lengths[b]` is the newest token
+    *,
+    window: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged decode attention; returns [B, H, D]."""
+    B, H, D = q.shape
+    C, KH = k_cache.shape[1], k_cache.shape[2]
+    bk = pick_block_kv(C) if block_kv is None else min(block_kv, C)
+    if C % bk:
+        raise ValueError(f"cache length {C} must divide block_kv {bk}")
+
+    kernel = functools.partial(
+        _decode_kernel,
+        num_kv_heads=KH,
+        head_dim=D,
+        block_kv=bk,
+        window=window,
+        sm_scale=1.0 / float(np.sqrt(D)),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
+            pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k cache stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        q,
+        k_cache.reshape(B, C, KH * D),
+        v_cache.reshape(B, C, KH * D),
+    )
+
+
+def decode_attention_reference(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Naive jnp ragged decode attention (CPU fallback + parity truth)."""
+    B, H, D = q.shape
+    C, KH = k_cache.shape[1], k_cache.shape[2]
+    G = H // KH
+    qg = q.reshape(B, KH, G, D)
+    s = jnp.einsum("bkgd,bckd->bkgc", qg, k_cache).astype(jnp.float32)
+    s = s / np.sqrt(D)
+    cols = jnp.arange(C)[None, :]
+    mask = cols <= lengths[:, None]
+    if window is not None:
+        mask = mask & (cols > lengths[:, None] - window)
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgc,bckd->bkgd", p, v_cache)
+    return out.reshape(B, H, D)

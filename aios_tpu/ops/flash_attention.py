@@ -1,0 +1,233 @@
+"""Blockwise (flash) causal attention for prefill and training.
+
+Replaces the naive score-materializing attention on the prefill path: the
+[T, S] score matrix never exists in HBM — each (query-block, kv-block) tile
+is produced in VMEM, folded into a running online softmax (max / sum / value
+accumulator), and discarded. This is the standard flash recurrence:
+
+    m'   = max(m, rowmax(S))
+    l'   = l * exp(m - m') + rowsum(exp(S - m'))
+    acc' = acc * exp(m - m') + exp(S - m') @ V
+
+Grid layout: (batch, q_head, q_block, kv_block) with kv_block innermost —
+on TPU the grid is executed sequentially per core, so VMEM scratch
+accumulators persist across the kv_block sweep for one query block.
+
+GQA is handled by index-mapping kv blocks through head // group_size; causal
+and sliding-window structure is exploited at block granularity (fully-masked
+tiles skip their compute entirely via pl.when).
+
+Reference behavior being replaced: llama.cpp's fused attention inside
+llama-server (SURVEY.md section 2.3) — here it is a first-class Mosaic
+kernel instead of an external binary.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128  # stat scratch is kept lane-replicated for layout friendliness
+
+
+def _flash_kernel(
+    q_ref,  # [1, 1, bq, D]
+    k_ref,  # [1, 1, bk, D]
+    v_ref,  # [1, 1, bk, D]
+    o_ref,  # [1, 1, bq, D]
+    m_scr,  # VMEM [bq, LANES] f32
+    l_scr,  # VMEM [bq, LANES] f32
+    acc_scr,  # VMEM [bq, D] f32
+    *,
+    causal: bool,
+    window: Optional[int],
+    block_q: int,
+    block_kv: int,
+    sm_scale: float,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = i * block_q
+    kv_start = j * block_kv
+
+    # Block-level structure: skip tiles that the causal / window masks kill
+    # entirely. Per-element masking inside _compute handles partial tiles.
+    # Causal kills tiles newer than the *newest* row; the window kills tiles
+    # older than what the *oldest* row (q_start) can still see.
+    run = jnp.bool_(True)
+    if causal:
+        run = kv_start <= q_start + block_q - 1
+    if window is not None:
+        run = jnp.logical_and(run, kv_start + block_kv - 1 > q_start - window)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :]  # [bq, D]
+        k = k_ref[0, 0, :, :]  # [bk, D]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        s = s * sm_scale
+
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        cols = kv_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        mask = None
+        if causal:
+            mask = cols <= rows
+        if window is not None:
+            win = cols > rows - window
+            mask = win if mask is None else jnp.logical_and(mask, win)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [bq, bk] f32
+        if mask is not None:
+            # rows fully masked in this tile have m_new = NEG_INF and would
+            # otherwise get p = exp(0) = 1 across the board
+            p = jnp.where(mask, p, 0.0)
+        l_cur = jnp.sum(p, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = l_prev * alpha + l_cur
+
+        acc = acc_scr[:] * alpha  # [bq, D]
+        acc_scr[:] = acc + jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l <= 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal",
+        "window",
+        "block_q",
+        "block_kv",
+        "interpret",
+    ),
+)
+def flash_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, S, KH, D]
+    v: jnp.ndarray,  # [B, S, KH, D]
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash GQA attention; drop-in for the naive reference (model layout)."""
+    B, T, H, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    G = H // KH
+    bq = min(block_q, T)
+    bk = min(block_kv, S)
+    if T % bq or S % bk:
+        raise ValueError(f"T={T} / S={S} must divide blocks ({bq}, {bk})")
+
+    # kernel layout: heads as a grid axis
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, T, D]
+    kt = k.transpose(0, 2, 1, 3)  # [B, KH, S, D]
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, T // bq, S // bk)
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        window=window,
+        block_q=bq,
+        block_kv=bk,
+        sm_scale=1.0 / float(np.sqrt(D)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, D), lambda b, h, i, j, G=G: (b, h // G, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, D), lambda b, h, i, j, G=G: (b, h // G, j, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)  # back to [B, T, H, D]
+
+
+def flash_attention_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Naive jnp GQA attention (CPU fallback + parity ground truth)."""
+    B, T, H, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    G = H // KH
+    qg = q.reshape(B, T, KH, G, D)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32)
+    s = s / np.sqrt(D)
+    rows = jnp.arange(T)[:, None]
+    cols = jnp.arange(S)[None, :]
+    mask = jnp.ones((T, S), bool)
+    if causal:
+        mask = cols <= rows
+    if window is not None:
+        mask = mask & (cols > rows - window)
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", p, v)
+    return out.reshape(B, T, H, D)

@@ -1,0 +1,125 @@
+"""Int8-weight matmul: weights stream from HBM as int8, dequantize in VMEM.
+
+Batched decode is HBM-bandwidth-bound on the weight matrices (measured on
+v5e: a full bf16 weight sweep of TinyLlama-1.1B costs ~4.3 ms — ~70% of the
+whole decode step). Storing weights as int8 with per-output-channel scales
+halves the streamed bytes; the kernel converts each int8 tile to bf16 in
+VMEM immediately before the MXU dot, so the bf16 copy never exists in HBM.
+XLA cannot be trusted to do this: an ``x @ w_int8.astype(bf16)`` graph may
+materialize the converted weight.
+
+Quantization is symmetric per-output-channel (scale = absmax/127 over the
+contraction axis), the same scheme GGUF Q8_0 uses per-block
+(SURVEY.md section 7 "GGUF Q4_K_M dequantization" — here quantization is a
+serving-time memory format, not a storage format).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def quantize_int8(w: jnp.ndarray, axis: int = -2) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization along ``axis`` (the contraction dim).
+
+    Returns (w_q int8, scale f32) with scale shaped like w but size 1 on
+    ``axis`` — for a [K, N] weight that is [1, N] (per-output-channel).
+    """
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    w_q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return w_q, scale.astype(jnp.float32)
+
+
+def dequantize(w_q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (w_q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr):
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    w = w_ref[:].astype(x_ref.dtype)  # int8 tile -> bf16 in VMEM
+    acc_scr[:] += jax.lax.dot_general(
+        x_ref[:],
+        w,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] * s_ref[:]).astype(o_ref.dtype)
+
+
+def _pick_block(dim: int, candidates=(512, 256, 128)) -> int:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _qmm_2d(x, w_q, scale, interpret=False):
+    M, K = x.shape
+    N = w_q.shape[1]
+    bk, bn = _pick_block(K), _pick_block(N)
+    grid = (N // bn, K // bk)
+    return pl.pallas_call(
+        _qmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((M, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk, bn), lambda i, j: (j, i)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda i, j: (0, i)),
+        scratch_shapes=[pltpu.VMEM((M, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, w_q, scale)
+
+
+def supports_pallas_qmm(K: int, N: int) -> bool:
+    """Kernel needs 128-multiple-aligned blocks on both matmul dims."""
+    return _pick_block(K) > 0 and _pick_block(N) > 0
+
+
+def quantized_matmul(
+    x: jnp.ndarray,  # [..., K] activations (bf16/f32)
+    w_q: jnp.ndarray,  # [K, N] int8
+    scale: jnp.ndarray,  # [1, N] f32
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ dequant(w_q) without ever materializing the dequantized weight."""
+    K, N = w_q.shape
+    lead = x.shape[:-1]
+    M = 1
+    for d in lead:
+        M *= d
+    x2 = x.reshape(M, K)
+    pad = (-M) % 8  # sublane alignment for small decode batches
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = _qmm_2d(x2, w_q, scale, interpret=interpret)
+    if pad:
+        out = out[:M]
+    return out.reshape(*lead, N)
+
+
+def quantized_matmul_reference(x, w_q, scale):
+    """Dequantize-then-matmul ground truth (CPU fallback)."""
+    w = dequantize(w_q, scale, dtype=jnp.float32)
+    return (x.astype(jnp.float32) @ w).astype(x.dtype)

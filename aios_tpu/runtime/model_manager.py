@@ -98,11 +98,24 @@ class ModelManager:
         num_slots: int = 8,
         sharding_plan=None,
         warm_compile: bool = True,
+        quantize: Optional[bool] = None,
     ) -> None:
         self.models: Dict[str, ManagedModel] = {}
         self.num_slots = num_slots
         self.plan = sharding_plan
         self.warm_compile = warm_compile
+        # int8 serving weights: the default on single-chip TPU (the reference
+        # serves Q4 GGUF through llama.cpp, so int8 is *more* precise than
+        # its default); AIOS_TPU_QUANTIZE=0 forces bf16 serving.
+        if quantize is None:
+            env = os.environ.get("AIOS_TPU_QUANTIZE", "").lower()
+            if env in ("0", "false", "off"):
+                quantize = False
+            elif env in ("1", "true", "int8"):
+                quantize = True
+            else:
+                quantize = sharding_plan is None
+        self.quantize = bool(quantize) and sharding_plan is None
         self._lock = threading.Lock()
 
     # -- loading ------------------------------------------------------------
@@ -127,6 +140,7 @@ class ModelManager:
                 num_slots=self.num_slots,
                 max_context=context_length or cfg.max_context,
                 shardings=self.plan,
+                quantize=self.quantize,
             )
             del params
             if self.warm_compile:

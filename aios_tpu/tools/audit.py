@@ -35,6 +35,10 @@ GENESIS = "0" * 64
 
 
 def _sha256(data: str) -> str:
+    from .. import native
+
+    if native.available():
+        return native.sha256_hex(data.encode("utf-8"))
     return hashlib.sha256(data.encode("utf-8")).hexdigest()
 
 

@@ -52,6 +52,15 @@ class RateLimiter:
         self._agents: Dict[str, object] = {}
         self._tools: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # Pay the native-library build/load at construction (service start),
+        # not inside check()'s lock on the first request — a cold g++ compile
+        # there would stall every concurrent tool call for seconds.
+        try:
+            from .. import native
+
+            native.load()
+        except Exception:  # noqa: BLE001
+            pass
 
     def check(self, agent_id: str, tool_name: str) -> tuple[bool, str]:
         with self._lock:
