@@ -1,0 +1,163 @@
+"""Weight / train-state checkpointing (orbax-backed, sharding-aware).
+
+The reference has no model checkpoints at all — it is inference-only over
+externally-downloaded GGUF files, and its notion of "resume" is goal/task
+state in SQLite (SURVEY.md section 5 "Checkpoint/resume"). The TPU build
+adds the missing half:
+
+  * serving weights: params saved once after load/quantize-prep, restored
+    directly to device (sharded restore when a mesh plan is given) — a
+    LoadModel from a checkpoint skips GGUF parse + dequant entirely;
+  * training: the full {params, opt_state, step} pytree checkpoints
+    atomically with retention, and `latest_step` powers crash resume, the
+    same pattern the reference applies to goals (goal_engine.rs:493-518)
+    lifted to model state.
+
+Orbax handles atomicity (tmp dir + rename), async-free single-controller
+writes, and per-leaf sharding metadata, so multi-chip restores place shards
+without a host-side gather.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+PARAMS_NAME = "params"
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints of an arbitrary pytree (train state)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = _abs(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, tree: Any, wait: bool = True) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        """Restore a checkpoint; ``like`` provides dtypes/shardings to
+        restore onto (abstract pytree of jax.ShapeDtypeStruct or arrays)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if like is not None:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        return self._mgr.restore(step)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_params(directory: str, params: Any) -> None:
+    """One-shot serving-weight checkpoint (no step indexing)."""
+    path = os.path.join(_abs(directory), PARAMS_NAME)
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(path, params, force=True)
+    ckpt.wait_until_finished()
+    ckpt.close()
+
+
+def load_params(directory: str, like: Any = None) -> Any:
+    """Restore serving weights; ``like`` carries target dtype/sharding."""
+    path = os.path.join(_abs(directory), PARAMS_NAME)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
+    ckpt = ocp.StandardCheckpointer()
+    try:
+        if like is not None:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return ckpt.restore(path, abstract)
+        return ckpt.restore(path)
+    finally:
+        ckpt.close()
+
+
+def is_checkpoint_dir(path: str) -> bool:
+    return os.path.isdir(os.path.join(_abs(path), PARAMS_NAME))
+
+
+# ---------------------------------------------------------------------------
+# Full model checkpoints: params + config + tokenizer in one directory.
+# This is the TPU analog of a prepared GGUF file — `scripts/prepare_model.py`
+# converts GGUF/HF sources into this format once, and LoadModel restores it
+# straight to device (no dequantization pass on the serving path).
+# ---------------------------------------------------------------------------
+
+MODEL_META_NAME = "aios_model.json"
+
+
+def save_model_checkpoint(directory: str, cfg, params, tokenizer) -> None:
+    import dataclasses
+    import json
+
+    from .tokenizer import HFTokenizer, tokenizer_to_dict
+
+    directory = _abs(directory)
+    os.makedirs(directory, exist_ok=True)
+    save_params(directory, params)
+    if isinstance(tokenizer, HFTokenizer):
+        # self-contained: copy the HF tokenizer files into the checkpoint so
+        # it deploys without the original model directory
+        tokenizer._tok.save_pretrained(os.path.join(directory, "tokenizer"))
+        tok_meta = {"type": "hf", "path": "tokenizer"}
+    else:
+        tok_meta = tokenizer_to_dict(tokenizer)
+    meta = {
+        "format": "aios-tpu-model-v1",
+        "config": dataclasses.asdict(cfg),
+        "tokenizer": tok_meta,
+    }
+    tmp = os.path.join(directory, MODEL_META_NAME + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp, os.path.join(directory, MODEL_META_NAME))
+
+
+def is_model_checkpoint(path: str) -> bool:
+    return os.path.isfile(
+        os.path.join(_abs(path), MODEL_META_NAME)
+    ) and is_checkpoint_dir(path)
+
+
+def load_model_checkpoint(directory: str):
+    """Returns (cfg, params, tokenizer) from a prepared model directory."""
+    import json
+
+    from .config import ModelConfig
+    from .tokenizer import tokenizer_from_dict
+
+    directory = _abs(directory)
+    with open(os.path.join(directory, MODEL_META_NAME)) as fh:
+        meta = json.load(fh)
+    cfg = ModelConfig(**meta["config"])
+    params = load_params(directory)
+    tok_meta = dict(meta["tokenizer"])
+    if tok_meta.get("type") == "hf" and not os.path.isabs(
+        tok_meta.get("path", "")
+    ):
+        tok_meta["path"] = os.path.join(directory, tok_meta["path"])
+    tokenizer = tokenizer_from_dict(tok_meta)
+    return cfg, params, tokenizer

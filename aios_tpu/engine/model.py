@@ -240,15 +240,21 @@ def _mlp(x, lp, cfg: ModelConfig):
 
 
 def forward_full(
-    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, attn_fn=None
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    attn_fn=None,
+    kernels: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Full-sequence causal forward; logits [B, T, V] in fp32.
 
     Used for training, numeric-parity testing and as the prefill core.
     ``attn_fn`` swaps the attention implementation (e.g. ring attention for
     sequence-parallel training); it defaults to in-core GQA attention.
+    ``kernels=False`` forces the pure-XLA path — required under autodiff:
+    the Pallas flash kernel is forward-only (no VJP rule yet).
     """
-    logits, _, _ = _forward_with_kv(params, cfg, tokens, attn_fn)
+    logits, _, _ = _forward_with_kv(params, cfg, tokens, attn_fn, kernels)
     return logits
 
 
@@ -346,8 +352,8 @@ def decode_step(
     def block(x, layer):
         lp, k_l, v_l = layer
         q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
-        k_l = k_l.at[batch_idx, lengths].set(k_new[:, 0])
-        v_l = v_l.at[batch_idx, lengths].set(v_new[:, 0])
+        k_l = k_l.at[batch_idx, lengths].set(k_new[:, 0].astype(k_l.dtype))
+        v_l = v_l.at[batch_idx, lengths].set(v_new[:, 0].astype(v_l.dtype))
         if use_kernel:
             attn = ops.decode_attention(
                 q[:, 0], k_l, v_l, lengths, window=cfg.sliding_window

@@ -182,6 +182,43 @@ class ByteTokenizer(BaseTokenizer):
 
 
 # ---------------------------------------------------------------------------
+# Serialization (model checkpoints carry their tokenizer, like GGUF does)
+# ---------------------------------------------------------------------------
+
+
+def tokenizer_to_dict(tok: BaseTokenizer) -> dict:
+    if isinstance(tok, SentencePieceBPE):
+        return {
+            "type": "spbpe",
+            "tokens": tok.tokens,
+            "scores": tok.scores,
+            "token_types": tok.token_types,
+            "bos_id": tok.bos_id,
+            "eos_id": tok.eos_id,
+            "add_prefix_space": tok.add_prefix_space,
+        }
+    if isinstance(tok, HFTokenizer):
+        return {"type": "hf", "path": tok._tok.name_or_path}
+    return {"type": "byte"}
+
+
+def tokenizer_from_dict(d: dict) -> BaseTokenizer:
+    t = d.get("type", "byte")
+    if t == "spbpe":
+        return SentencePieceBPE(
+            tokens=list(d["tokens"]),
+            scores=list(d["scores"]),
+            token_types=list(d["token_types"]),
+            bos_id=d.get("bos_id"),
+            eos_id=d.get("eos_id"),
+            add_prefix_space=d.get("add_prefix_space", True),
+        )
+    if t == "hf":
+        return HFTokenizer(d["path"])
+    return ByteTokenizer()
+
+
+# ---------------------------------------------------------------------------
 # Chat templating (llama-server applied the GGUF chat template; we do the
 # same per model family for the prompt/system_prompt pair)
 # ---------------------------------------------------------------------------

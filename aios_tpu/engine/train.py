@@ -63,16 +63,18 @@ def make_train_step(
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         attn_fn = make_ring_attn_fn(mesh)
 
+    # kernels=False: the Pallas flash kernel is forward-only; training must
+    # take the differentiable XLA attention (or the explicit ring attn_fn)
     forward = model.forward_full
     if remat:
-        forward = jax.checkpoint(forward, static_argnums=(1, 3))
+        forward = jax.checkpoint(forward, static_argnums=(1, 3, 4))
 
     def loss_fn(params, tokens, loss_mask):
         if mesh is not None:
             tokens = jax.lax.with_sharding_constraint(
                 tokens, NamedSharding(mesh, P("dp", "sp"))
             )
-        logits = forward(params, cfg, tokens, attn_fn)  # [B, T, V] fp32
+        logits = forward(params, cfg, tokens, attn_fn, False)  # [B, T, V]
         labels = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits[:, :-1])
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
@@ -104,3 +106,50 @@ def make_train_step(
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
     return init_state, train_step
+
+
+def train_loop(
+    cfg: ModelConfig,
+    params,
+    batches,
+    *,
+    mesh: Optional[Mesh] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    checkpoint_dir: Optional[str] = None,
+    save_every: int = 100,
+    max_steps: Optional[int] = None,
+    on_metrics: Optional[Callable[[int, Dict], None]] = None,
+) -> TrainState:
+    """Run train steps over ``batches`` with crash-safe checkpoint/resume.
+
+    The resume pattern mirrors the reference's goal-state recovery (SQLite
+    survives restarts, in-progress work resets and continues,
+    goal_engine.rs:493-518) applied to model state: if ``checkpoint_dir``
+    holds a checkpoint, training restarts from its exact {params, opt_state,
+    step} — the incoming ``params`` only define shapes/shardings.
+    """
+    init_state, train_step = make_train_step(cfg, mesh, optimizer)
+    state = init_state(params)
+    manager = None
+    if checkpoint_dir is not None:
+        from .checkpoint import CheckpointManager
+
+        manager = CheckpointManager(checkpoint_dir)
+        if manager.latest_step() is not None:
+            state = manager.restore(like=state)
+
+    step_fn = jax.jit(train_step, donate_argnums=(0,))
+    step = int(state["step"])
+    for batch in batches:
+        if max_steps is not None and step >= max_steps:
+            break
+        state, metrics = step_fn(state, batch)
+        step += 1
+        if on_metrics is not None:
+            on_metrics(step, metrics)
+        if manager is not None and step % save_every == 0:
+            manager.save(step, state)
+    if manager is not None:
+        manager.save(step, state)
+        manager.close()
+    return state

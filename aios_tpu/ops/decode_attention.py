@@ -172,7 +172,9 @@ def decode_attention(
     C, KH = k_cache.shape[1], k_cache.shape[2]
     bk = pick_block_kv(C) if block_kv is None else min(block_kv, C)
     if C % bk:
-        raise ValueError(f"cache length {C} must divide block_kv {bk}")
+        raise ValueError(
+            f"block_kv {bk} must evenly divide cache length {C}"
+        )
 
     kernel = functools.partial(
         _decode_kernel,

@@ -106,7 +106,9 @@ class ModelManager:
         self.warm_compile = warm_compile
         # int8 serving weights: the default on single-chip TPU (the reference
         # serves Q4 GGUF through llama.cpp, so int8 is *more* precise than
-        # its default); AIOS_TPU_QUANTIZE=0 forces bf16 serving.
+        # its default); AIOS_TPU_QUANTIZE=0 forces bf16 serving. CPU-fallback
+        # backends keep dense weights — without the TPU int8 dot they would
+        # re-dequantize every matmul.
         if quantize is None:
             env = os.environ.get("AIOS_TPU_QUANTIZE", "").lower()
             if env in ("0", "false", "off"):
@@ -114,7 +116,13 @@ class ModelManager:
             elif env in ("1", "true", "int8"):
                 quantize = True
             else:
-                quantize = sharding_plan is None
+                try:
+                    import jax
+
+                    on_tpu = jax.default_backend() == "tpu"
+                except Exception:  # noqa: BLE001
+                    on_tpu = False
+                quantize = sharding_plan is None and on_tpu
         self.quantize = bool(quantize) and sharding_plan is None
         self._lock = threading.Lock()
 
@@ -205,7 +213,18 @@ class ModelManager:
                 cfg = cfg.scaled(max_context=context_length)
             return cfg, params, tokenizer
 
-        if p.is_dir():  # HF checkpoint directory
+        if p.is_dir():
+            from ..engine import checkpoint as ckpt_mod
+
+            if ckpt_mod.is_model_checkpoint(str(p)):
+                # prepared aios-tpu checkpoint: params restore straight to
+                # device, no GGUF parse/dequant on the serving path
+                cfg, params, tokenizer = ckpt_mod.load_model_checkpoint(str(p))
+                if context_length:
+                    cfg = cfg.scaled(max_context=context_length)
+                return cfg, params, tokenizer
+
+            # HF checkpoint directory
             import json
 
             import safetensors.numpy

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Prepare a model for TPU serving: GGUF/HF source -> aios-tpu checkpoint.
+
+TPU analog of the reference's model pipeline (scripts/download-models.sh
+fetches GGUF files; scripts/build-llamacpp.sh builds the engine that parses
+them on every load). Here the expensive work — GGUF parse, Q4_K/Q6_K
+dequantization, HF safetensors mapping — happens ONCE, producing a
+checkpoint directory {params/ (orbax), aios_model.json (config+tokenizer)}
+that `AIRuntime.LoadModel` restores straight to device.
+
+Usage:
+  python scripts/prepare_model.py /path/model.gguf  /var/lib/aios/models/name
+  python scripts/prepare_model.py /path/hf_dir      /var/lib/aios/models/name
+  python scripts/prepare_model.py synthetic://tinyllama-1.1b out_dir  # tests
+
+Options:
+  --dtype bf16|f32     serving dtype for dense weights (default bf16)
+  --context N          override max_context recorded in the config
+  --verify             run a short greedy generation after writing
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("source", help="GGUF file, HF dir, or synthetic://preset")
+    ap.add_argument("out", help="output checkpoint directory")
+    ap.add_argument("--dtype", default="bf16", choices=("bf16", "f32"))
+    ap.add_argument("--context", type=int, default=0)
+    ap.add_argument("--verify", action="store_true")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import checkpoint as ckpt
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    t0 = time.time()
+    mgr = ModelManager(warm_compile=False)
+    name = Path(args.out).name
+    try:
+        cfg, params, tokenizer = mgr._load_weights(
+            name, args.source, args.context
+        )
+    except (FileNotFoundError, KeyError) as exc:
+        print(f"error: cannot load {args.source!r}: {exc}", file=sys.stderr)
+        return 2
+    if dtype != jnp.bfloat16:
+        from aios_tpu.engine import weights as weights_mod
+
+        params = weights_mod.map_params(params, lambda a: a.astype(dtype))
+    print(
+        f"loaded {cfg.name}: {cfg.num_params() / 1e9:.2f}B params, "
+        f"vocab {cfg.vocab_size}, ctx {cfg.max_context} "
+        f"({time.time() - t0:.1f}s)",
+        file=sys.stderr,
+    )
+
+    t0 = time.time()
+    ckpt.save_model_checkpoint(args.out, cfg, params, tokenizer)
+    print(f"checkpoint written to {args.out} ({time.time() - t0:.1f}s)",
+          file=sys.stderr)
+
+    if args.verify:
+        from aios_tpu.engine.engine import TPUEngine
+
+        cfg2, params2, tok2 = ckpt.load_model_checkpoint(args.out)
+        eng = TPUEngine(
+            cfg2, params2, num_slots=1,
+            max_context=min(256, cfg2.max_context),
+        )
+        ids = tok2.encode("The quick brown fox")
+        out = eng.generate(ids, max_new_tokens=8, temperature=0.0)
+        print(f"verify: generated {len(out)} tokens: {tok2.decode(out)!r}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
