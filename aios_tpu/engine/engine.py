@@ -65,14 +65,17 @@ class TPUEngine:
         self._lock = threading.Lock()
         self.plan = shardings
         self.quantized = bool(quantize)
+        # int8 KV cache: half the cache footprint/traffic; scales ride along
+        # in the decode state and rows quantize on write inside the graph
+        self.quant_cache = cache_dtype == jnp.int8
         # Pallas kernels are per-device programs; under a sharding plan the
         # global-array paths must stay pure XLA (GSPMD partitions those).
         self._kernels: Optional[bool] = False if shardings is not None else None
 
         if shardings is not None:
-            if quantize:
+            if quantize or self.quant_cache:
                 raise NotImplementedError(
-                    "int8 serving weights are single-chip for now"
+                    "int8 serving weights / KV cache are single-chip for now"
                 )
             self.params = shardings.put_params(params)
         else:
@@ -92,6 +95,10 @@ class TPUEngine:
             "top_ps": jnp.ones((num_slots,), jnp.float32),
             "key": jax.random.PRNGKey(seed),
         }
+        if self.quant_cache:
+            k_s, v_s = model.init_kv_scales(cfg, num_slots, self.max_context)
+            self.state["k_s"] = k_s
+            self.state["v_s"] = v_s
 
         # host-side mirror for the scheduler
         self.active = np.zeros(num_slots, dtype=bool)
@@ -107,15 +114,27 @@ class TPUEngine:
         def one(carry, _):
             st = carry
             key, sub = jax.random.split(st["key"])
-            logits, k, v = model.decode_step(
-                params,
-                self.cfg,
-                st["last_tokens"],
-                st["lengths"],
-                st["k"],
-                st["v"],
-                kernels=self._kernels,
-            )
+            if self.quant_cache:
+                logits, k, v, (k_s, v_s) = model.decode_step(
+                    params,
+                    self.cfg,
+                    st["last_tokens"],
+                    st["lengths"],
+                    st["k"],
+                    st["v"],
+                    kernels=self._kernels,
+                    cache_scales=(st["k_s"], st["v_s"]),
+                )
+            else:
+                logits, k, v = model.decode_step(
+                    params,
+                    self.cfg,
+                    st["last_tokens"],
+                    st["lengths"],
+                    st["k"],
+                    st["v"],
+                    kernels=self._kernels,
+                )
             next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
             st = {
                 "k": k,
@@ -126,6 +145,9 @@ class TPUEngine:
                 "top_ps": st["top_ps"],
                 "key": key,
             }
+            if self.quant_cache:
+                st["k_s"] = k_s
+                st["v_s"] = v_s
             return st, next_tokens
 
         state, tokens = jax.lax.scan(one, state, None, length=n_steps)
@@ -137,17 +159,30 @@ class TPUEngine:
         logits, ks, vs = model.prefill(
             params, self.cfg, tokens, kernels=self._kernels
         )
+        # ks/vs [L, B=1, T, KH, D] -> cache layout [L, slot, T, KH, D]
         start = (0, slot, 0, 0, 0)
-        k = jax.lax.dynamic_update_slice(
-            state["k"], ks.astype(state["k"].dtype), start
-        )
-        v = jax.lax.dynamic_update_slice(
-            state["v"], vs.astype(state["v"].dtype), start
-        )
+        if self.quant_cache:
+            kq, ks_scale = model.quantize_kv(ks)
+            vq, vs_scale = model.quantize_kv(vs)
+            k = jax.lax.dynamic_update_slice(state["k"], kq, start)
+            v = jax.lax.dynamic_update_slice(state["v"], vq, start)
+            k_s = jax.lax.dynamic_update_slice(
+                state["k_s"], ks_scale, start[:-1]
+            )
+            v_s = jax.lax.dynamic_update_slice(
+                state["v_s"], vs_scale, start[:-1]
+            )
+        else:
+            k = jax.lax.dynamic_update_slice(
+                state["k"], ks.astype(state["k"].dtype), start
+            )
+            v = jax.lax.dynamic_update_slice(
+                state["v"], vs.astype(state["v"].dtype), start
+            )
         key, sub = jax.random.split(state["key"])
         last = logits[0, true_len - 1][None, :]  # [1, V]
         first = sampling.sample(last, sub, temp[None], top_p[None])[0]
-        return {
+        out = {
             "k": k,
             "v": v,
             "lengths": state["lengths"].at[slot].set(true_len),
@@ -155,7 +190,11 @@ class TPUEngine:
             "temps": state["temps"].at[slot].set(temp),
             "top_ps": state["top_ps"].at[slot].set(top_p),
             "key": key,
-        }, first
+        }
+        if self.quant_cache:
+            out["k_s"] = k_s
+            out["v_s"] = v_s
+        return out, first
 
     def _step_fn(self, n_steps: int):
         fn = self._step_fns.get(n_steps)

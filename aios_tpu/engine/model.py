@@ -221,6 +221,22 @@ def _project_qkv(x, lp, cfg: ModelConfig, cos, sin):
     return q, k, v
 
 
+def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None):
+    """One transformer block on [B, T, E]; returns (x', (k, v)).
+
+    The single source of truth for block structure — the prefill/training
+    forward, the decode step, and the pipeline-parallel stage all build on
+    it (pipeline.py discards the returned k/v).
+    """
+    attention = attention or gqa_attention
+    B, T = x.shape[0], x.shape[1]
+    q, k, v = _project_qkv(x, lp, cfg, cos, sin)
+    attn = attention(q, k, v, mask)
+    x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
+    x = x + _mlp(x, lp, cfg)
+    return x, (k, v)
+
+
 def _mlp(x, lp, cfg: ModelConfig):
     h = rms_norm(x, lp["ffn_norm"], cfg.rms_norm_eps)
     if "w_gateup" in lp:  # fused serving layout (quantize_params)
@@ -292,11 +308,7 @@ def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=Non
     mask = causal_mask(T, cfg.sliding_window)
 
     def block(x, lp):
-        q, k, v = _project_qkv(x, lp, cfg, cos, sin)
-        attn = attention(q, k, v, mask)
-        x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
-        return x, (k, v)
+        return apply_block(x, lp, cfg, cos, sin, mask, attention)
 
     x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -315,25 +327,34 @@ def decode_step(
     k_cache: jnp.ndarray,  # [L, B, C, KH, D]
     v_cache: jnp.ndarray,  # [L, B, C, KH, D]
     kernels: Optional[bool] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+):
     """One batched decode step over the slot cache.
 
     Writes the new K/V at row ``lengths[b]`` of each slot, attends over all
     valid rows (with sliding window if configured), and returns
-    (logits [B, V] fp32, k_cache', v_cache'). Intended to be jitted with the
-    caches donated so XLA updates them in place.
+    (logits [B, V] fp32, k_cache', v_cache'[, (k_scales', v_scales')]).
+    Intended to be jitted with the caches donated so XLA updates them in
+    place.
 
     ``kernels`` — None picks the Pallas ragged-attention kernel on TPU
     (reads only rows [0, length] per slot from HBM); False forces the naive
     full-cache path (required when the cache is sharded over a mesh — the
     kernel is per-device).
+
+    ``cache_scales`` — (k_scales, v_scales) [L, B, C, KH] f32 marks an int8
+    KV cache: new rows are quantized per (row, head) on write and the cache
+    dequantizes while being read — half the cache HBM traffic and footprint
+    of bf16 (the attention math itself stays bf16/fp32).
     """
     B = tokens.shape[0]
     C = k_cache.shape[2]
+    quant_cache = cache_scales is not None
     # The ragged kernel's DMA-only-valid-rows win beats its per-layer launch
     # cost once the cache is long; below that XLA's fused full-cache read is
-    # faster (measured crossover on v5e around 2k rows).
-    use_kernel = _use_kernels(kernels) and C >= 2048
+    # faster (measured crossover on v5e around 2k rows). The kernel reads
+    # bf16 caches only, so the int8-cache path stays on XLA.
+    use_kernel = _use_kernels(kernels) and C >= 2048 and not quant_cache
     x = params["embed"][tokens][:, None, :]  # [B, 1, E]
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
 
@@ -350,28 +371,56 @@ def decode_step(
         mask = mask[:, None, :]  # [B, 1, C]
 
     def block(x, layer):
-        lp, k_l, v_l = layer
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
-        k_l = k_l.at[batch_idx, lengths].set(k_new[:, 0].astype(k_l.dtype))
-        v_l = v_l.at[batch_idx, lengths].set(v_new[:, 0].astype(v_l.dtype))
-        if use_kernel:
-            attn = ops.decode_attention(
-                q[:, 0], k_l, v_l, lengths, window=cfg.sliding_window
-            )[:, None]
+        if quant_cache:
+            lp, k_l, v_l, k_s, v_s = layer
         else:
-            attn = gqa_attention(q, k_l, v_l, mask)
+            lp, k_l, v_l = layer
+            k_s = v_s = None
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        if quant_cache:
+            kq, ks_new = quantize_kv(k_new[:, 0])
+            vq, vs_new = quantize_kv(v_new[:, 0])
+            k_l = k_l.at[batch_idx, lengths].set(kq)
+            v_l = v_l.at[batch_idx, lengths].set(vq)
+            k_s = k_s.at[batch_idx, lengths].set(ks_new)
+            v_s = v_s.at[batch_idx, lengths].set(vs_new)
+            attn = gqa_attention(
+                q,
+                dequantize_kv(k_l, k_s, q.dtype),
+                dequantize_kv(v_l, v_s, q.dtype),
+                mask,
+            )
+        else:
+            k_l = k_l.at[batch_idx, lengths].set(k_new[:, 0].astype(k_l.dtype))
+            v_l = v_l.at[batch_idx, lengths].set(v_new[:, 0].astype(v_l.dtype))
+            if use_kernel:
+                attn = ops.decode_attention(
+                    q[:, 0], k_l, v_l, lengths, window=cfg.sliding_window
+                )[:, None]
+            else:
+                attn = gqa_attention(q, k_l, v_l, mask)
         x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
         x = x + _mlp(x, lp, cfg)
+        if quant_cache:
+            return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        block, x, (params["layers"], k_cache, v_cache)
-    )
+    if quant_cache:
+        k_scales, v_scales = cache_scales
+        x, (k_cache, v_cache, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["layers"], k_cache, v_cache, k_scales, v_scales)
+        )
+    else:
+        x, (k_cache, v_cache) = jax.lax.scan(
+            block, x, (params["layers"], k_cache, v_cache)
+        )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     logits = matmul(x[:, 0], head).astype(jnp.float32)
+    if quant_cache:
+        return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache
 
 
@@ -421,3 +470,28 @@ def init_kv_cache(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_kv_scales(
+    cfg: ModelConfig, num_slots: int, max_len: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(row, kv-head) scales for an int8 KV cache."""
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads)
+    return jnp.ones(shape, jnp.float32), jnp.ones(shape, jnp.float32)
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 over the head dim. x [..., D] -> (int8 [..., D], f32 [...])."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)).astype(
+        dtype
+    )

@@ -24,6 +24,17 @@ from .config import ModelConfig
 TrainState = Dict  # {"params": pytree, "opt_state": pytree, "step": int32}
 
 
+def token_cross_entropy(logits, tokens, loss_mask):
+    """Next-token NLL. Returns (masked nll sum, mask sum) — the label/mask
+    convention shared by the GSPMD and pipeline-parallel train steps:
+    position t's label is tokens[t+1], the last column is ignored."""
+    labels = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = loss_mask[:, :-1]
+    return -(ll * mask).sum(), mask.sum()
+
+
 def make_optimizer(
     learning_rate: float = 3e-4,
     weight_decay: float = 0.1,
@@ -75,12 +86,8 @@ def make_train_step(
                 tokens, NamedSharding(mesh, P("dp", "sp"))
             )
         logits = forward(params, cfg, tokens, attn_fn, False)  # [B, T, V]
-        labels = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits[:, :-1])
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        mask = loss_mask[:, :-1]
-        denom = jnp.maximum(mask.sum(), 1.0)
-        return -(ll * mask).sum() / denom
+        nll, denom = token_cross_entropy(logits, tokens, loss_mask)
+        return nll / jnp.maximum(denom, 1.0)
 
     def init_state(params) -> TrainState:
         return {

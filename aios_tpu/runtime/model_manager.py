@@ -124,6 +124,24 @@ class ModelManager:
                     on_tpu = False
                 quantize = sharding_plan is None and on_tpu
         self.quantize = bool(quantize) and sharding_plan is None
+        # AIOS_TPU_KV_CACHE=int8 halves KV-cache footprint/traffic (the
+        # long-context + co-residency lever); default bf16
+        kv_env = os.environ.get("AIOS_TPU_KV_CACHE", "").lower()
+        self.cache_dtype = jnp.bfloat16
+        if kv_env == "int8":
+            if sharding_plan is None:
+                self.cache_dtype = jnp.int8
+            else:
+                log.warning(
+                    "AIOS_TPU_KV_CACHE=int8 ignored: int8 KV cache is "
+                    "single-chip for now (sharding plan set); using bf16"
+                )
+        elif kv_env and kv_env not in ("bf16", "bfloat16"):
+            log.warning(
+                "unrecognized AIOS_TPU_KV_CACHE=%r (expected 'int8'); "
+                "using bf16",
+                kv_env,
+            )
         self._lock = threading.Lock()
 
     # -- loading ------------------------------------------------------------
@@ -149,6 +167,7 @@ class ModelManager:
                 max_context=context_length or cfg.max_context,
                 shardings=self.plan,
                 quantize=self.quantize,
+                cache_dtype=self.cache_dtype,
             )
             del params
             if self.warm_compile:

@@ -216,3 +216,58 @@ def test_quantized_forward_logits_close():
     denom = float(jnp.linalg.norm(lf)) + 1e-9
     rel = float(jnp.linalg.norm(lq - lf)) / denom
     assert rel < 0.05, rel
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache
+# ---------------------------------------------------------------------------
+
+
+def test_kv_quantize_roundtrip():
+    from aios_tpu.engine import model as M
+
+    x = _rand(jax.random.PRNGKey(10), (4, 7, 2, 64), scale=2.0)
+    q, s = M.quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (4, 7, 2)
+    back = M.dequantize_kv(q, s, jnp.float32)
+    bound = np.asarray(jnp.max(jnp.abs(x), axis=-1) / 127.0)
+    err = np.abs(np.asarray(back - x))
+    assert (err <= bound[..., None] + 1e-6).all()
+
+
+def test_int8_kv_cache_engine_close_to_float():
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(11), dtype=jnp.float32)
+    eng_f = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                      cache_dtype=jnp.float32)
+    eng_q = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                      cache_dtype=jnp.int8)
+    assert eng_q.quant_cache and "k_s" in eng_q.state
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    out_f = eng_f.generate(prompt, max_new_tokens=10, temperature=0.0)
+    out_q = eng_q.generate(prompt, max_new_tokens=10, temperature=0.0)
+    # int8 KV on a tiny random model: early greedy tokens must agree
+    assert out_f[:3] == out_q[:3]
+
+
+def test_int8_kv_cache_slot_isolation():
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(12), dtype=jnp.float32)
+    eng = TPUEngine(TINY_TEST, params, num_slots=4, max_context=64,
+                    cache_dtype=jnp.int8)
+    # run a decode with another slot active, then check a fresh slot's
+    # output matches a single-slot engine (no cross-slot contamination)
+    eng.prefill(2, [9, 8, 7], temperature=0.0)
+    eng.step(4)
+    out = eng.generate([3, 1, 4], max_new_tokens=6, temperature=0.0, slot=0)
+
+    eng2 = TPUEngine(TINY_TEST, params, num_slots=4, max_context=64,
+                     cache_dtype=jnp.int8)
+    out2 = eng2.generate([3, 1, 4], max_new_tokens=6, temperature=0.0, slot=0)
+    assert out == out2
