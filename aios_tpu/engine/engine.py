@@ -54,7 +54,8 @@ class TPUEngine:
         cache_dtype=jnp.bfloat16,
         seed: int = 0,
         shardings=None,  # optional ShardingPlan (aios_tpu.parallel.sharding)
-        quantize: bool = False,  # int8 serving weights (single-chip path)
+        quantize: bool = False,  # int8 serving weights
+        sharded_attention: Optional[bool] = None,  # shard_map ragged decode
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -69,19 +70,52 @@ class TPUEngine:
         # in the decode state and rows quantize on write inside the graph
         self.quant_cache = cache_dtype == jnp.int8
         # Pallas kernels are per-device programs; under a sharding plan the
-        # global-array paths must stay pure XLA (GSPMD partitions those).
+        # global-array paths must stay pure XLA (GSPMD partitions those) —
+        # EXCEPT decode attention, which is head/slot-local and runs the
+        # ragged kernel per device under shard_map (see _attn_impl below).
         self._kernels: Optional[bool] = False if shardings is not None else None
 
         if shardings is not None:
-            if quantize or self.quant_cache:
-                raise NotImplementedError(
-                    "int8 serving weights / KV cache are single-chip for now"
+            if quantize:
+                # unfused layout: each projection's output dim shards on tp,
+                # scales follow (sharding.py quantized-leaf rules); the
+                # int8 x bf16 dot_generals partition like their dense
+                # counterparts, with GSPMD inserting the same psums
+                self.params = shardings.put_params(
+                    model.quantize_params(params, fuse=False)
                 )
-            self.params = shardings.put_params(params)
+            else:
+                self.params = shardings.put_params(params)
         else:
             self.params = jax.tree.map(jnp.asarray, params)
             if quantize:
                 self.params = model.quantize_params(self.params)
+
+        # Ragged decode attention under shard_map: auto on TPU meshes with a
+        # bf16 cache long enough for the kernel to win (same crossover as
+        # the single-chip ladder); force with sharded_attention=True to
+        # exercise the path on CPU virtual meshes (jnp reference body).
+        self._attn_impl = None
+        if sharded_attention and (shardings is None or self.quant_cache):
+            raise ValueError(
+                "sharded_attention=True needs a sharding plan and a bf16 KV "
+                "cache (the ragged kernel reads bf16 caches only)"
+            )
+        if shardings is not None and not self.quant_cache:
+            on_tpu = False
+            try:
+                on_tpu = jax.default_backend() == "tpu"
+            except Exception:
+                pass
+            enable = (
+                sharded_attention
+                if sharded_attention is not None
+                else on_tpu and self.max_context >= 2048
+            )
+            if enable:
+                self._attn_impl = shardings.ragged_attention(
+                    cfg.sliding_window, use_kernel=on_tpu
+                )
 
         k, v = model.init_kv_cache(cfg, num_slots, self.max_context, cache_dtype)
         if shardings is not None:
@@ -93,10 +127,17 @@ class TPUEngine:
             "last_tokens": jnp.zeros((num_slots,), jnp.int32),
             "temps": jnp.zeros((num_slots,), jnp.float32),
             "top_ps": jnp.ones((num_slots,), jnp.float32),
+            # device-side mirror of the host `active` array: inactive slots
+            # cost no cache bandwidth in decode and write only to the
+            # sacrificial last row (model.decode_step)
+            "active": jnp.zeros((num_slots,), jnp.bool_),
             "key": jax.random.PRNGKey(seed),
         }
         if self.quant_cache:
             k_s, v_s = model.init_kv_scales(cfg, num_slots, self.max_context)
+            if shardings is not None:
+                k_s = shardings.put_cache_scales(k_s)
+                v_s = shardings.put_cache_scales(v_s)
             self.state["k_s"] = k_s
             self.state["v_s"] = v_s
 
@@ -106,6 +147,7 @@ class TPUEngine:
 
         self._step_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[int, object] = {}
+        self._chunk_fns: Dict[Tuple[int, bool], object] = {}
         self.decode_steps = 0
 
     # -- jitted cores -------------------------------------------------------
@@ -124,6 +166,7 @@ class TPUEngine:
                     st["v"],
                     kernels=self._kernels,
                     cache_scales=(st["k_s"], st["v_s"]),
+                    active=st["active"],
                 )
             else:
                 logits, k, v = model.decode_step(
@@ -134,6 +177,8 @@ class TPUEngine:
                     st["k"],
                     st["v"],
                     kernels=self._kernels,
+                    active=st["active"],
+                    attn_impl=self._attn_impl,
                 )
             next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
             st = {
@@ -143,6 +188,7 @@ class TPUEngine:
                 "last_tokens": next_tokens,
                 "temps": st["temps"],
                 "top_ps": st["top_ps"],
+                "active": st["active"],
                 "key": key,
             }
             if self.quant_cache:
@@ -189,12 +235,54 @@ class TPUEngine:
             "last_tokens": state["last_tokens"].at[slot].set(first),
             "temps": state["temps"].at[slot].set(temp),
             "top_ps": state["top_ps"].at[slot].set(top_p),
+            "active": state["active"].at[slot].set(True),
             "key": key,
         }
         if self.quant_cache:
             out["k_s"] = k_s
             out["v_s"] = v_s
         return out, first
+
+    def _prefill_chunk_impl(self, params, state: DecodeState, tokens, slot, start):
+        """Mid-prompt chunk: write K/V rows [start, start+Tc), no sampling."""
+        scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
+        out = model.prefill_chunk(
+            params, self.cfg, tokens, slot, start, state["k"], state["v"],
+            cache_scales=scales,
+        )
+        new = dict(state)
+        if self.quant_cache:
+            _, new["k"], new["v"], (new["k_s"], new["v_s"]) = out
+        else:
+            _, new["k"], new["v"] = out
+        return new
+
+    def _final_chunk_impl(
+        self, params, state: DecodeState, tokens, slot, start, n_valid,
+        true_len, temp, top_p,
+    ):
+        """Last chunk: write K/V, then sample the first token from the
+        logits row of the prompt's true last token and activate the slot."""
+        scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
+        out = model.prefill_chunk(
+            params, self.cfg, tokens, slot, start, state["k"], state["v"],
+            cache_scales=scales,
+        )
+        new = dict(state)
+        if self.quant_cache:
+            logits, new["k"], new["v"], (new["k_s"], new["v_s"]) = out
+        else:
+            logits, new["k"], new["v"] = out
+        key, sub = jax.random.split(state["key"])
+        last = logits[0, n_valid - 1][None, :]  # [1, V]
+        first = sampling.sample(last, sub, temp[None], top_p[None])[0]
+        new["lengths"] = state["lengths"].at[slot].set(true_len)
+        new["last_tokens"] = state["last_tokens"].at[slot].set(first)
+        new["temps"] = state["temps"].at[slot].set(temp)
+        new["top_ps"] = state["top_ps"].at[slot].set(top_p)
+        new["active"] = state["active"].at[slot].set(True)
+        new["key"] = key
+        return new, first
 
     def _step_fn(self, n_steps: int):
         fn = self._step_fns.get(n_steps)
@@ -210,6 +298,15 @@ class TPUEngine:
         if fn is None:
             fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
             self._prefill_fns[bucket] = fn
+        return fn
+
+    def _chunk_fn(self, bucket: int, final: bool):
+        key = (bucket, final)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            impl = self._final_chunk_impl if final else self._prefill_chunk_impl
+            fn = jax.jit(impl, donate_argnums=(1,))
+            self._chunk_fns[key] = fn
         return fn
 
     # -- public API ---------------------------------------------------------
@@ -255,6 +352,28 @@ class TPUEngine:
             self._host_lengths[slot] = true_len
             return int(first)
 
+    def start_chunked_prefill(
+        self,
+        slot: int,
+        token_ids: List[int],
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        chunk: int = 512,
+    ) -> "ChunkedPrefill":
+        """Begin an incremental prefill of ``slot``; the caller drives it by
+        calling ``.step()`` once per chunk and may run decode dispatches for
+        the other slots in between (the continuous batcher does exactly
+        that). Requires ``chunk`` to be a prefill bucket dividing
+        max_context so chunk writes never spill past the cache end."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if chunk not in self.buckets or self.max_context % chunk:
+            raise ValueError(
+                f"chunk {chunk} must be a prefill bucket dividing "
+                f"max_context={self.max_context}"
+            )
+        return ChunkedPrefill(self, slot, token_ids, temperature, top_p, chunk)
+
     def step(self, n_steps: int = 1) -> np.ndarray:
         """Run ``n_steps`` batched decode steps in one dispatch.
 
@@ -275,17 +394,47 @@ class TPUEngine:
         self._host_lengths[slot] = 0
         with self._lock:
             self.state["lengths"] = self.state["lengths"].at[slot].set(0)
+            self.state["active"] = self.state["active"].at[slot].set(False)
 
     def slot_length(self, slot: int) -> int:
         return int(self._host_lengths[slot])
 
-    def warmup(self, step_sizes: Tuple[int, ...] = (1, 8)) -> None:
+    # Admission granularity for long prompts; the batcher's default chunk
+    # size and warmup's pre-compiled chunk graphs both read this, so the
+    # production graphs and the readiness gate can't drift apart.
+    prefill_chunk_default = 512
+
+    def warmup(
+        self,
+        step_sizes: Tuple[int, ...] = (1, 8),
+        prefill_chunk: Optional[int] = None,  # None -> prefill_chunk_default
+    ) -> None:
         """Pre-compile decode + prefill buckets (LoadModel readiness gate —
         the reference's /health polling equivalent, model_manager.rs:222-263;
-        without this the first Infer would eat 20-40 s of XLA compile)."""
+        without this the first Infer would eat 20-40 s of XLA compile).
+
+        Also compiles the chunked-admission graphs (mid chunk + every final
+        bucket <= ``prefill_chunk``) so the first long prompt after the
+        readiness gate doesn't stall active decode on an XLA compile inside
+        the scheduler thread. Pass the batcher's chunk size if it overrides
+        the shared default, or 0 to skip.
+        """
         for bucket in self.buckets:
             self.prefill(0, [1] * min(4, bucket))
             self.release(0)
+        ck = self.prefill_chunk_default if prefill_chunk is None else prefill_chunk
+        if not ck:
+            ck = None
+        if ck is not None and ck in self.buckets and self.max_context % ck == 0:
+            for b in self.buckets:
+                if b > ck:
+                    break
+                # remainder in (b/2, b] so bucket_for(remainder) == b
+                n = min(ck + b // 2 + 1, self.max_context - 1)
+                pc = self.start_chunked_prefill(0, [1] * n, chunk=ck)
+                while pc.step() is None:
+                    pass
+                self.release(0)
         for n in step_sizes:
             self.step(n)
 
@@ -321,3 +470,85 @@ class TPUEngine:
                 if t in stop_tokens:
                     return out[: i + 1]
         return out
+
+
+class ChunkedPrefill:
+    """Driver for an in-flight incremental prefill of one slot.
+
+    Each ``step()`` call processes one chunk (holding the engine lock only
+    for that chunk's dispatch); between calls the owner may run
+    ``engine.step`` for the other slots. The final chunk samples the first
+    token, activates the slot, and is returned from ``step()``.
+
+    While chunks are in flight the slot's device-side ``active`` flag stays
+    False, so interleaved decode dispatches write this slot's (ignored) K/V
+    to the sacrificial last cache row — never corrupting rows the prefill
+    has already filled — and stream zero cache rows for it
+    (model.decode_step's ``active`` gating). The sacrificial row is never
+    read: the mask only exposes rows [0, length] and a request retires when
+    its length reaches max_context - 1.
+    """
+
+    def __init__(
+        self,
+        engine: TPUEngine,
+        slot: int,
+        token_ids: List[int],
+        temperature: float,
+        top_p: float,
+        chunk: int,
+    ) -> None:
+        ids = list(token_ids)[-(engine.max_context - 1) :]
+        if not ids:
+            raise ValueError("empty prompt")
+        self.engine = engine
+        self.slot = slot
+        self.ids = ids
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.chunk = int(chunk)
+        self.pos = 0
+        self.first_token: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self.first_token is not None
+
+    def step(self) -> Optional[int]:
+        """Process the next chunk; returns the first sampled token when the
+        prompt is fully admitted, else None."""
+        if self.done:
+            return self.first_token
+        eng = self.engine
+        remaining = len(self.ids) - self.pos
+        final = remaining <= self.chunk
+        n = min(self.chunk, remaining)
+        bucket = eng.bucket_for(n) if final else self.chunk
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, :n] = self.ids[self.pos : self.pos + n]
+        with eng._lock:
+            if final:
+                eng.state, first = eng._chunk_fn(bucket, True)(
+                    eng.params,
+                    eng.state,
+                    jnp.asarray(padded),
+                    jnp.int32(self.slot),
+                    jnp.int32(self.pos),
+                    jnp.int32(n),
+                    jnp.int32(len(self.ids)),
+                    jnp.float32(self.temperature),
+                    jnp.float32(self.top_p),
+                )
+                eng.active[self.slot] = True
+                eng._host_lengths[self.slot] = len(self.ids)
+                self.first_token = int(first)
+            else:
+                eng.state = eng._chunk_fn(bucket, False)(
+                    eng.params,
+                    eng.state,
+                    jnp.asarray(padded),
+                    jnp.int32(self.slot),
+                    jnp.int32(self.pos),
+                )
+        self.pos += n
+        return self.first_token

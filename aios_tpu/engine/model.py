@@ -77,17 +77,24 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
-def quantize_params(params: Params, include_head: bool = True) -> Params:
+def quantize_params(
+    params: Params, include_head: bool = True, fuse: bool = True
+) -> Params:
     """Convert matmul weights to int8 serving leaves {"q": int8, "s": f32}.
 
     Serving-format transformations applied together:
       * symmetric per-output-channel int8 — halves the weight bytes streamed
         from HBM per decode step (the measured bottleneck);
-      * matmul fusion — wq|wk|wv concatenate into one [E, Q+2KV] ``w_qkv``
-        and w_gate|w_up into one [E, 2F] ``w_gateup``, so each decode step
-        issues 4 weight matmuls per layer instead of 7;
+      * matmul fusion (``fuse=True``) — wq|wk|wv concatenate into one
+        [E, Q+2KV] ``w_qkv`` and w_gate|w_up into one [E, 2F] ``w_gateup``,
+        so each decode step issues 4 weight matmuls per layer instead of 7;
       * a tied lm_head is materialized as its own quantized [E, V] matrix so
         the logits matmul streams int8 too.
+
+    ``fuse=False`` keeps the seven per-layer weights separate — required
+    under a tensor-parallel sharding plan, where each projection's output
+    dim shards on the tp axis and a fused concat would interleave q/k/v
+    columns across shards (sharding.py quantized-leaf rules).
 
     Norms and the embedding gather stay bf16 (negligible bandwidth). The
     dense layout is untouched — training and sharding plans use it.
@@ -99,14 +106,18 @@ def quantize_params(params: Params, include_head: bool = True) -> Params:
         for k, v in src.items()
         if k not in QUANT_KEYS
     }
-    qkv = jnp.concatenate([src["wq"], src["wk"], src["wv"]], axis=-1)
-    gateup = jnp.concatenate([src["w_gate"], src["w_up"]], axis=-1)
-    for key, w in (
-        ("w_qkv", qkv),
-        ("wo", src["wo"]),
-        ("w_gateup", gateup),
-        ("w_down", src["w_down"]),
-    ):
+    if fuse:
+        qkv = jnp.concatenate([src["wq"], src["wk"], src["wv"]], axis=-1)
+        gateup = jnp.concatenate([src["w_gate"], src["w_up"]], axis=-1)
+        to_quant = (
+            ("w_qkv", qkv),
+            ("wo", src["wo"]),
+            ("w_gateup", gateup),
+            ("w_down", src["w_down"]),
+        )
+    else:
+        to_quant = tuple((k, src[k]) for k in QUANT_KEYS)
+    for key, w in to_quant:
         q, s = ops.quantize_int8(w, axis=-2)
         layers[key] = {"q": q, "s": s}
     out["layers"] = layers
@@ -178,6 +189,61 @@ def gqa_attention(
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(B, T, H, D)
+
+
+def blockwise_cache_attention(
+    q: jnp.ndarray,  # [1, Tc, H, D]
+    k: jnp.ndarray,  # [1, C, KH, D]
+    v: jnp.ndarray,  # [1, C, KH, D]
+    abs_pos: jnp.ndarray,  # [Tc] absolute position of each query row
+    window: Optional[int],
+    block: int = 512,
+) -> jnp.ndarray:
+    """Chunk-vs-cache attention via an online softmax over KV blocks.
+
+    The [Tc, C] score matrix never materializes: each [Tc, block] tile is
+    folded into running (max, denom, accumulator) stats under ``lax.scan``
+    (the flash recurrence in plain XLA, so it runs on every backend). This
+    is what keeps chunked admission of an 8k prompt from allocating
+    hundreds of MB of fp32 scores per layer. Query row i sees cache col j
+    iff j <= abs_pos[i] (and inside the sliding window) — the row's own
+    K/V was written to the cache before this is called, so the diagonal is
+    always visible and the denominator can't be zero.
+    """
+    B, Tc, H, D = q.shape
+    C = k.shape[1]
+    KH = k.shape[2]
+    G = H // KH
+    qf = q[0].reshape(Tc, KH, G, D).astype(jnp.float32) / np.sqrt(D)
+    nb = C // block
+    kb = k[0].astype(jnp.float32).reshape(nb, block, KH, D)
+    vb = v[0].astype(jnp.float32).reshape(nb, block, KH, D)
+    colsb = jnp.arange(C).reshape(nb, block)
+
+    def fold(carry, xs):
+        m, l, acc = carry
+        kblk, vblk, cols = xs
+        s = jnp.einsum("tkgd,ckd->kgtc", qf, kblk)  # [KH, G, Tc, block]
+        visible = cols[None, :] <= abs_pos[:, None]  # [Tc, block]
+        if window is not None:
+            visible = visible & (cols[None, :] > abs_pos[:, None] - window)
+        s = jnp.where(visible[None, None], s, jnp.float32(-1e30))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)  # rescale of previous stats
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("kgtc,ckd->kgtd", p, vblk)
+        return (m_new, l, acc), None
+
+    init = (
+        jnp.full((KH, G, Tc), -1e30, jnp.float32),
+        jnp.zeros((KH, G, Tc), jnp.float32),
+        jnp.zeros((KH, G, Tc, D), jnp.float32),
+    )
+    (m, l, acc), _ = jax.lax.scan(fold, init, (kb, vb, colsb))
+    out = acc / l[..., None]
+    # [KH, G, Tc, D] -> [1, Tc, H, D]
+    return out.transpose(2, 0, 1, 3).reshape(B, Tc, H, D).astype(q.dtype)
 
 
 def causal_mask(T: int, window: Optional[int]) -> jnp.ndarray:
@@ -288,6 +354,18 @@ def _use_kernels(kernels: Optional[bool]) -> bool:
     return ops.use_pallas() if kernels is None else bool(kernels)
 
 
+def _ragged_min_c() -> int:
+    """Cache length where the ragged decode kernel starts winning over
+    XLA's fused full-cache read (measured crossover on v5e ~2k rows;
+    AIOS_TPU_RAGGED_MIN_C overrides for A/B runs, read at trace time)."""
+    import os
+
+    try:
+        return int(os.environ.get("AIOS_TPU_RAGGED_MIN_C", "2048"))
+    except ValueError:
+        return 2048
+
+
 def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=None):
     B, T = tokens.shape
     x = params["embed"][tokens]
@@ -319,6 +397,121 @@ def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=Non
     return logits, ks, vs
 
 
+def prefill_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [1, Tc] int32 — one chunk of one prompt
+    slot: jnp.ndarray,  # scalar int32 — destination cache slot
+    start: jnp.ndarray,  # scalar int32 — absolute position of tokens[0]
+    k_cache: jnp.ndarray,  # [L, S, C, KH, D]
+    v_cache: jnp.ndarray,  # [L, S, C, KH, D]
+    cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+):
+    """One chunk of an incremental prefill against the slot cache.
+
+    Writes the chunk's K/V at rows [start, start+Tc) of ``slot`` and attends
+    each chunk token over all cache rows written so far (causal within the
+    chunk, everything before ``start`` visible, sliding window honoured) —
+    so an 8k prompt can be admitted as 16 x 512-token chunks with decode
+    dispatches for the other slots interleaved between them, instead of one
+    monolithic prefill that stalls every active request (the head-of-line
+    block the reference inherits from llama-server's serial queue,
+    SURVEY.md section 7 hard-part #1).
+
+    Returns (logits [1, Tc, V] fp32, k_cache', v_cache'[, scales']).
+    Rows past ``start+Tc`` are garbage and masked; the caller samples from
+    the logits row of the prompt's true last token on the final chunk.
+    """
+    B, Tc = tokens.shape
+    C = k_cache.shape[2]
+    quant_cache = cache_scales is not None
+    x = params["embed"][tokens]  # [1, Tc, E]
+    positions = start + jnp.arange(Tc)[None, :]  # [1, Tc]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    kv_tile = min(512, C)  # NB: local `block` below would shadow this
+    if C % kv_tile == 0:
+        mask = None  # blockwise online-softmax path; mask built per tile
+
+        def attend(q, k_all, v_all):
+            return blockwise_cache_attention(
+                q, k_all, v_all, positions[0], cfg.sliding_window, kv_tile
+            )
+    else:
+        # chunk row i (abs pos start+i) sees cache col j iff j <= start+i
+        cols = jnp.arange(C)[None, :]  # [1, C]
+        abs_pos = positions[0][:, None]  # [Tc, 1]
+        mask = cols <= abs_pos
+        if cfg.sliding_window is not None:
+            mask = mask & (cols > abs_pos - cfg.sliding_window)
+        mask = mask[None]  # [1, Tc, C]
+
+        def attend(q, k_all, v_all):
+            return gqa_attention(q, k_all, v_all, mask)
+
+    write_at = (slot, start, jnp.int32(0), jnp.int32(0))
+
+    def block(x, layer):
+        if quant_cache:
+            lp, k_l, v_l, k_s, v_s = layer
+        else:
+            lp, k_l, v_l = layer
+            k_s = v_s = None
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        # k_new/v_new [1, Tc, KH, D] drop straight into the slot-cache layout
+        # [S, C, KH, D] at (slot, start, 0, 0)
+        if quant_cache:
+            kq, ks_new = quantize_kv(k_new)
+            vq, vs_new = quantize_kv(v_new)
+            k_l = jax.lax.dynamic_update_slice(k_l, kq, write_at)
+            v_l = jax.lax.dynamic_update_slice(v_l, vq, write_at)
+            k_s = jax.lax.dynamic_update_slice(k_s, ks_new, write_at[:-1])
+            v_s = jax.lax.dynamic_update_slice(v_s, vs_new, write_at[:-1])
+            k_all = dequantize_kv(
+                jax.lax.dynamic_slice_in_dim(k_l, slot, 1, axis=0),
+                jax.lax.dynamic_slice_in_dim(k_s, slot, 1, axis=0),
+                q.dtype,
+            )
+            v_all = dequantize_kv(
+                jax.lax.dynamic_slice_in_dim(v_l, slot, 1, axis=0),
+                jax.lax.dynamic_slice_in_dim(v_s, slot, 1, axis=0),
+                q.dtype,
+            )
+        else:
+            k_l = jax.lax.dynamic_update_slice(
+                k_l, k_new.astype(k_l.dtype), write_at
+            )
+            v_l = jax.lax.dynamic_update_slice(
+                v_l, v_new.astype(v_l.dtype), write_at
+            )
+            k_all = jax.lax.dynamic_slice_in_dim(k_l, slot, 1, axis=0)
+            v_all = jax.lax.dynamic_slice_in_dim(v_l, slot, 1, axis=0)
+        attn = attend(q, k_all.astype(q.dtype), v_all.astype(q.dtype))
+        x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"])
+        x = x + _mlp(x, lp, cfg)
+        if quant_cache:
+            return x, (k_l, v_l, k_s, v_s)
+        return x, (k_l, v_l)
+
+    if quant_cache:
+        k_scales, v_scales = cache_scales
+        x, (k_cache, v_cache, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["layers"], k_cache, v_cache, k_scales, v_scales)
+        )
+    else:
+        x, (k_cache, v_cache) = jax.lax.scan(
+            block, x, (params["layers"], k_cache, v_cache)
+        )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = matmul(x, head).astype(jnp.float32)
+    if quant_cache:
+        return logits, k_cache, v_cache, (k_scales, v_scales)
+    return logits, k_cache, v_cache
+
+
 def decode_step(
     params: Params,
     cfg: ModelConfig,
@@ -328,6 +521,8 @@ def decode_step(
     v_cache: jnp.ndarray,  # [L, B, C, KH, D]
     kernels: Optional[bool] = None,
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    active: Optional[jnp.ndarray] = None,  # [B] bool
+    attn_impl=None,  # (q [B,H,D], k_l, v_l, lengths) -> [B,H,D]
 ):
     """One batched decode step over the slot cache.
 
@@ -336,6 +531,13 @@ def decode_step(
     (logits [B, V] fp32, k_cache', v_cache'[, (k_scales', v_scales')]).
     Intended to be jitted with the caches donated so XLA updates them in
     place.
+
+    ``active`` — slots marked False write their (ignored) K/V to the
+    sacrificial last cache row and attend over zero rows, so an inactive or
+    mid-chunked-prefill slot costs no cache bandwidth and cannot corrupt
+    rows an incremental admission has already written. The fixed-shape
+    graph still computes every slot's matmuls; only the cache traffic and
+    writes are gated. None means all slots active.
 
     ``kernels`` — None picks the Pallas ragged-attention kernel on TPU
     (reads only rows [0, length] per slot from HBM); False forces the naive
@@ -346,6 +548,11 @@ def decode_step(
     KV cache: new rows are quantized per (row, head) on write and the cache
     dequantizes while being read — half the cache HBM traffic and footprint
     of bf16 (the attention math itself stays bf16/fp32).
+
+    ``attn_impl`` — explicit attention callable, overriding the kernel
+    ladder; used by the tensor-parallel engine to run the ragged kernel
+    per-device under shard_map (ShardingPlan.ragged_attention). bf16
+    caches only.
     """
     B = tokens.shape[0]
     C = k_cache.shape[2]
@@ -354,20 +561,40 @@ def decode_step(
     # cost once the cache is long; below that XLA's fused full-cache read is
     # faster (measured crossover on v5e around 2k rows). The kernel reads
     # bf16 caches only, so the int8-cache path stays on XLA.
-    use_kernel = _use_kernels(kernels) and C >= 2048 and not quant_cache
+    # The ragged kernel wins when the cache bytes it avoids streaming beat
+    # its per-layer launch cost: either a long cache outright (>= 2k rows,
+    # the TinyLlama-measured crossover) or a large-model cache whose
+    # C x (KH x D) slab is >= 1 MiB of rows per slot (Mistral-7B at 1k rows
+    # measures +11% whole-step throughput on v5e).
+    kv_row = cfg.num_kv_heads * cfg.head_dim
+    use_kernel = (
+        attn_impl is None
+        and _use_kernels(kernels)
+        and (C >= _ragged_min_c() or C * kv_row >= 1 << 20)
+        and not quant_cache
+    )
+    if active is None:
+        write_rows = lengths
+        read_lengths = lengths
+    else:
+        write_rows = jnp.where(active, lengths, C - 1)
+        # read length -1 would be ideal; 0 exposes one (overwritten-before-
+        # read for active slots, garbage-but-ignored otherwise) row, which
+        # keeps the mask/kernel contract "row `length` was just written"
+        read_lengths = jnp.where(active, lengths, 0)
     x = params["embed"][tokens][:, None, :]  # [B, 1, E]
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
 
     batch_idx = jnp.arange(B)
-    if use_kernel:
+    if use_kernel or attn_impl is not None:
         mask = None
     else:
         cols = jnp.arange(C)[None, :]
         # col j is visible if it holds a written token (j <= lengths, since
         # the new token is written before attending) and is inside the window
-        mask = cols <= lengths[:, None]
+        mask = cols <= read_lengths[:, None]
         if cfg.sliding_window is not None:
-            mask = mask & (cols > (lengths[:, None] - cfg.sliding_window))
+            mask = mask & (cols > (read_lengths[:, None] - cfg.sliding_window))
         mask = mask[:, None, :]  # [B, 1, C]
 
     def block(x, layer):
@@ -380,10 +607,10 @@ def decode_step(
         if quant_cache:
             kq, ks_new = quantize_kv(k_new[:, 0])
             vq, vs_new = quantize_kv(v_new[:, 0])
-            k_l = k_l.at[batch_idx, lengths].set(kq)
-            v_l = v_l.at[batch_idx, lengths].set(vq)
-            k_s = k_s.at[batch_idx, lengths].set(ks_new)
-            v_s = v_s.at[batch_idx, lengths].set(vs_new)
+            k_l = k_l.at[batch_idx, write_rows].set(kq)
+            v_l = v_l.at[batch_idx, write_rows].set(vq)
+            k_s = k_s.at[batch_idx, write_rows].set(ks_new)
+            v_s = v_s.at[batch_idx, write_rows].set(vs_new)
             attn = gqa_attention(
                 q,
                 dequantize_kv(k_l, k_s, q.dtype),
@@ -391,11 +618,13 @@ def decode_step(
                 mask,
             )
         else:
-            k_l = k_l.at[batch_idx, lengths].set(k_new[:, 0].astype(k_l.dtype))
-            v_l = v_l.at[batch_idx, lengths].set(v_new[:, 0].astype(v_l.dtype))
-            if use_kernel:
+            k_l = k_l.at[batch_idx, write_rows].set(k_new[:, 0].astype(k_l.dtype))
+            v_l = v_l.at[batch_idx, write_rows].set(v_new[:, 0].astype(v_l.dtype))
+            if attn_impl is not None:
+                attn = attn_impl(q[:, 0], k_l, v_l, read_lengths)[:, None]
+            elif use_kernel:
                 attn = ops.decode_attention(
-                    q[:, 0], k_l, v_l, lengths, window=cfg.sliding_window
+                    q[:, 0], k_l, v_l, read_lengths, window=cfg.sliding_window
                 )[:, None]
             else:
                 attn = gqa_attention(q, k_l, v_l, mask)
@@ -463,6 +692,70 @@ def init_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = normal((E, cfg.vocab_size))
     return params
+
+
+def init_quantized_params(
+    cfg: ModelConfig, key: jax.Array, fuse: bool = True, dtype=jnp.bfloat16
+) -> Params:
+    """Random params built DIRECTLY in the int8 serving layout
+    (``quantize_params`` output shapes) — the bf16 weights never
+    materialize, so a 7B model inits in ~7 GB of HBM instead of ~22 GB.
+    Benchmarks and dry-runs only: decode throughput is weight-value-
+    independent (same bytes streamed, same FLOPs), and each quantized
+    tensor tiles one random 2-D block over the layer axis to keep the
+    init's own peak memory at one layer's worth.
+    """
+    keys = iter(jax.random.split(key, 16))
+    L, E, F, D = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    V = cfg.vocab_size
+
+    def qleaf(shape):
+        block = jax.random.randint(
+            next(keys), shape[-2:], -127, 128, jnp.int32
+        ).astype(jnp.int8)
+        q = jnp.asarray(jnp.broadcast_to(block, shape))
+        s_shape = shape[:-2] + (1, shape[-1])
+        return {"q": q, "s": jnp.full(s_shape, 0.02 / 127.0, jnp.float32)}
+
+    layers = {
+        "attn_norm": jnp.ones((L, E), dtype),
+        "ffn_norm": jnp.ones((L, E), dtype),
+    }
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, D), dtype)
+        layers["k_norm"] = jnp.ones((L, D), dtype)
+    if fuse:
+        layers["w_qkv"] = qleaf((L, E, cfg.q_dim + 2 * cfg.kv_dim))
+        layers["wo"] = qleaf((L, cfg.q_dim, E))
+        layers["w_gateup"] = qleaf((L, E, 2 * F))
+        layers["w_down"] = qleaf((L, F, E))
+    else:
+        layers["wq"] = qleaf((L, E, cfg.q_dim))
+        layers["wk"] = qleaf((L, E, cfg.kv_dim))
+        layers["wv"] = qleaf((L, E, cfg.kv_dim))
+        layers["wo"] = qleaf((L, cfg.q_dim, E))
+        layers["w_gate"] = qleaf((L, E, F))
+        layers["w_up"] = qleaf((L, E, F))
+        layers["w_down"] = qleaf((L, F, E))
+    return {
+        "embed": (
+            jax.random.normal(next(keys), (V, E), jnp.float32) * 0.02
+        ).astype(dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((E,), dtype),
+        "lm_head": qleaf((E, V)),
+    }
+
+
+def serving_weight_bytes(params: Params) -> int:
+    """Bytes of weight data streamed from HBM per decode step (every
+    matmul weight + scales; embedding gather excluded — one row)."""
+    total = 0
+    for leaf in jax.tree.leaves(params["layers"]) + jax.tree.leaves(
+        params.get("lm_head", [])
+    ):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
 
 
 def init_kv_cache(

@@ -25,7 +25,6 @@ for numeric parity tests (kernels additionally run under
 from __future__ import annotations
 
 import os
-from functools import lru_cache
 
 import jax
 
@@ -51,17 +50,26 @@ __all__ = [
 ]
 
 
-@lru_cache(maxsize=1)
+_BACKEND_IS_TPU: bool | None = None
+
+
 def use_pallas() -> bool:
     """True when the Pallas kernel path should be used.
 
     On TPU backends the kernels are the default; ``AIOS_TPU_NO_PALLAS=1``
     forces the jnp reference path (debugging / A-B benchmarking). Non-TPU
     backends always take the reference path — the kernels are Mosaic-only.
+
+    The backend probe is cached only on success: a transient init failure
+    (e.g. the tunnelled TPU backend coming up late) must not pin the slow
+    path for the process lifetime.
     """
     if os.environ.get("AIOS_TPU_NO_PALLAS", "").lower() in ("1", "true"):
         return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    global _BACKEND_IS_TPU
+    if _BACKEND_IS_TPU is None:
+        try:
+            _BACKEND_IS_TPU = jax.default_backend() == "tpu"
+        except Exception:
+            return False  # retry on the next call
+    return _BACKEND_IS_TPU

@@ -43,8 +43,8 @@ def dequantize(w_q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.
 
 
 def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr):
-    j = pl.program_id(1)
-    nk = pl.num_programs(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
 
     @pl.when(j == 0)
     def _init():
@@ -70,23 +70,30 @@ def _pick_block(dim: int, candidates=(512, 256, 128)) -> int:
     return 0
 
 
+# Rows of x processed per grid step. Bounds the VMEM footprint for large-M
+# callers (prefill/training): x block bm*bk*2B + scratch bm*bn*4B stay well
+# under a v5e core's ~16 MB VMEM regardless of sequence length.
+M_BLOCK = 256
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _qmm_2d(x, w_q, scale, interpret=False):
     M, K = x.shape
     N = w_q.shape[1]
+    bm = M if M <= M_BLOCK else M_BLOCK  # callers pad M to a multiple
     bk, bn = _pick_block(K), _pick_block(N)
-    grid = (N // bn, K // bk)
+    grid = (M // bm, N // bn, K // bk)
     return pl.pallas_call(
         _qmm_kernel,
         out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((M, bk), lambda i, j: (0, j)),
-            pl.BlockSpec((bk, bn), lambda i, j: (j, i)),
-            pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+            pl.BlockSpec((bm, bk), lambda m, i, j: (m, j)),
+            pl.BlockSpec((bk, bn), lambda m, i, j: (j, i)),
+            pl.BlockSpec((1, bn), lambda m, i, j: (0, i)),
         ],
-        out_specs=pl.BlockSpec((M, bn), lambda i, j: (0, i)),
-        scratch_shapes=[pltpu.VMEM((M, bn), jnp.float32)],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, i, j: (m, i)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=interpret,
     )(x, w_q, scale)
 
@@ -110,7 +117,9 @@ def quantized_matmul(
     for d in lead:
         M *= d
     x2 = x.reshape(M, K)
-    pad = (-M) % 8  # sublane alignment for small decode batches
+    # sublane alignment for small decode batches; multiple of M_BLOCK for
+    # large prefill/training M so the kernel's M grid divides evenly
+    pad = (-M) % (8 if M <= M_BLOCK else M_BLOCK)
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
     out = _qmm_2d(x2, w_q, scale, interpret=interpret)

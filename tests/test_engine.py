@@ -39,6 +39,53 @@ def test_greedy_decode_matches_uncached_forward(tiny_engine):
     assert got == want
 
 
+def test_chunked_prefill_matches_monolithic(tiny_engine):
+    """Admitting a prompt in 32-token chunks must yield the same first token
+    and greedy continuation as one monolithic prefill."""
+    prompt = (np.arange(1, 100) % 250 + 1).tolist()  # 99 tokens
+    first_a = tiny_engine.prefill(0, prompt, temperature=0.0)
+    toks_a = [int(t) for t in tiny_engine.step(8)[:, 0]]
+    tiny_engine.release(0)
+
+    pc = tiny_engine.start_chunked_prefill(1, prompt, temperature=0.0, chunk=32)
+    steps = 0
+    first_b = None
+    while first_b is None:
+        first_b = pc.step()
+        steps += 1
+    assert steps == 4 and pc.done  # 32 + 32 + 32 + 3
+    toks_b = [int(t) for t in tiny_engine.step(8)[:, 1]]
+    tiny_engine.release(1)
+
+    assert first_b == first_a
+    assert toks_b == toks_a
+
+
+def test_chunked_prefill_int8_cache_matches_monolithic():
+    """Chunked admission under the int8 KV cache quantizes rows on write
+    exactly like the monolithic path (same per-row scales)."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    a = TPUEngine(TINY_TEST, params, num_slots=2, max_context=128,
+                  cache_dtype=jnp.int8)
+    b = TPUEngine(TINY_TEST, params, num_slots=2, max_context=128,
+                  cache_dtype=jnp.int8)
+    prompt = (np.arange(1, 80) % 250 + 1).tolist()
+    first_a = a.prefill(0, prompt, temperature=0.0)
+    toks_a = [int(t) for t in a.step(6)[:, 0]]
+    pc = b.start_chunked_prefill(0, prompt, temperature=0.0, chunk=32)
+    first_b = None
+    while first_b is None:
+        first_b = pc.step()
+    toks_b = [int(t) for t in b.step(6)[:, 0]]
+    assert first_b == first_a
+    assert toks_b == toks_a
+
+
+def test_chunked_prefill_rejects_non_bucket_chunk(tiny_engine):
+    with pytest.raises(ValueError):
+        tiny_engine.start_chunked_prefill(0, [1, 2, 3], chunk=48)
+
+
 def test_generate_respects_stop_tokens(tiny_engine):
     prompt = [3, 17, 91, 4, 55, 8]
     free_run = tiny_engine.generate(prompt, max_new_tokens=10, temperature=0.0)
