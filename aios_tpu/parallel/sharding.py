@@ -71,6 +71,8 @@ PARAM_RULES: Dict[str, P] = {
 
 # KV cache [L, slots, C, KH, D]: slots over dp, kv heads over tp.
 CACHE_SPEC = P(None, "dp", None, "tp", None)
+# int8 KV-cache scales [L, slots, C, KH] ride the same placement.
+CACHE_SCALE_SPEC = P(None, "dp", None, "tp")
 
 
 @dataclass
@@ -82,6 +84,16 @@ class ShardingPlan:
     def spec_for(self, path: str) -> P:
         if path in PARAM_RULES:
             return PARAM_RULES[path]
+        # int8 serving leaves {"q", "s"} (model.quantize_params fuse=False):
+        # the int8 tensor shards exactly like the dense weight it replaces;
+        # the per-output-channel scale is size 1 on the contraction dim
+        # (axis -2), so its spec is the weight's with that axis unsharded.
+        if path.endswith(("/q", "/s")):
+            base = PARAM_RULES.get(path[:-2])
+            if base is not None:
+                if path.endswith("/q"):
+                    return base
+                return P(*base[:-2], None, base[-1])
         raise KeyError(f"no partition rule for param {path!r}")
 
     def params_shardings(self, params) -> Dict:
@@ -105,6 +117,49 @@ class ShardingPlan:
 
     def put_cache(self, cache):
         return jax.device_put(cache, NamedSharding(self.mesh, CACHE_SPEC))
+
+    def put_cache_scales(self, scales):
+        return jax.device_put(
+            scales, NamedSharding(self.mesh, CACHE_SCALE_SPEC)
+        )
+
+    def ragged_attention(self, window: Optional[int], use_kernel: bool):
+        """Per-device ragged decode attention under shard_map.
+
+        Attention is head- and slot-local, so with q sharded (dp, tp) and
+        the per-layer cache (dp, none, tp) every device attends its own
+        [B/dp, C, KH/tp, D] shard with ZERO collectives — the Pallas ragged
+        kernel (ops/decode_attention.py) runs per device exactly as on one
+        chip. ``use_kernel=False`` swaps in the jnp reference body (CPU
+        virtual meshes; numerics identical), which is how the dryrun and the
+        test suite exercise this path without TPU hardware.
+
+        Returns attn(q [B,H,D], k_l [B,C,KH,D], v_l [B,C,KH,D], lengths [B])
+        -> [B, H, D], for model.decode_step's ``attn_impl`` hook.
+        """
+        from jax.experimental.shard_map import shard_map
+
+        from .. import ops
+
+        def local(q, k_l, v_l, lengths):
+            if use_kernel:
+                return ops.decode_attention(q, k_l, v_l, lengths, window=window)
+            return ops.decode_attention_reference(
+                q, k_l, v_l, lengths, window=window
+            )
+
+        return shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(
+                P("dp", "tp", None),
+                P("dp", None, "tp", None),
+                P("dp", None, "tp", None),
+                P("dp"),
+            ),
+            out_specs=P("dp", "tp", None),
+            check_rep=False,
+        )
 
     @property
     def tp(self) -> int:

@@ -58,6 +58,63 @@ def test_tp_decode_matches_single_device(tiny_params, cpu_devices):
     assert got == want
 
 
+def test_tp_int8_weights_decode_matches_single_device(tiny_params, cpu_devices):
+    """int8 serving weights compose with the TP plan (VERDICT r2 item 3):
+    the unfused quantized layout under dp x tp must reproduce the
+    single-device fused-int8 engine's greedy decode exactly — the per-column
+    scales are identical in both layouts."""
+    prompt = [3, 17, 91, 4, 55, 8]
+    ref = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=64,
+        cache_dtype=jnp.float32, quantize=True,
+    )
+    want = ref.generate(prompt, max_new_tokens=8)
+
+    plan = ShardingPlan(build_mesh(4, dp=2))  # dp=2 x tp=2
+    tp = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=64,
+        cache_dtype=jnp.float32, quantize=True, shardings=plan,
+    )
+    got = tp.generate(prompt, max_new_tokens=8)
+    assert got == want
+
+
+def test_tp_int8_kv_cache_decode_matches_single_device(tiny_params, cpu_devices):
+    """Full serving config under TP: int8 weights + int8 KV cache sharded
+    (slots on dp, kv heads on tp, scales alongside)."""
+    prompt = [3, 17, 91, 4, 55, 8]
+    ref = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=64,
+        cache_dtype=jnp.int8, quantize=True,
+    )
+    want = ref.generate(prompt, max_new_tokens=8)
+
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    tp = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=64,
+        cache_dtype=jnp.int8, quantize=True, shardings=plan,
+    )
+    got = tp.generate(prompt, max_new_tokens=8)
+    assert got == want
+
+
+def test_sharded_ragged_attention_matches_gspmd(tiny_params, cpu_devices):
+    """The shard_mapped per-device ragged decode attention (the path the
+    Pallas kernel takes on a TPU mesh; jnp body here) must match the plain
+    GSPMD-partitioned attention."""
+    prompt = [3, 17, 91, 4, 55, 8]
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    kw = dict(num_slots=4, max_context=64, cache_dtype=jnp.float32,
+              shardings=plan)
+    want = TPUEngine(TINY_TEST, tiny_params, **kw).generate(
+        prompt, max_new_tokens=8
+    )
+    got = TPUEngine(
+        TINY_TEST, tiny_params, sharded_attention=True, **kw
+    ).generate(prompt, max_new_tokens=8)
+    assert got == want
+
+
 def test_ring_attention_matches_full_attention(cpu_devices):
     B, T, H, KH, D = 2, 32, 4, 2, 16
     rng = np.random.default_rng(0)
