@@ -204,3 +204,36 @@ class LocalRuntimeClient:
             model=resp.model_used or "local",
             provider=self.name,
         )
+
+    def stream_infer(self, prompt: str, system: str, max_tokens: int,
+                     temperature: float):
+        """Yield text deltas live from the runtime's StreamInfer.
+
+        This is the true-streaming path the reference never had: its
+        inference.rs:261 buffers the whole completion and re-chunks it, a
+        quirk the runtime service here already fixed — so the gateway pipes
+        the live token stream instead of replicating the buffer-then-chunk
+        behavior (router.route_stream).
+        """
+        import grpc
+
+        from ..proto_gen import runtime_pb2
+
+        try:
+            stream = self._get_stub().StreamInfer(
+                runtime_pb2.InferRequest(
+                    prompt=prompt,
+                    system_prompt=system,
+                    max_tokens=max_tokens or 512,
+                    temperature=temperature,
+                ),
+                timeout=300,
+            )
+            for chunk in stream:
+                if chunk.text:
+                    yield chunk.text
+                if chunk.done:
+                    return
+        except grpc.RpcError as exc:
+            self._stub = None
+            raise ProviderError(f"local runtime: {exc.details()}") from exc

@@ -108,6 +108,29 @@ class RequestRouter:
         order = [p for p in PRIORITY if self._usable(p)]
         return order or ["local"]
 
+    def _candidates(self, preferred: str, allow_fallback: bool, errors: List[str]):
+        """Yield (name, provider) for each usable candidate in policy order —
+        the ONE selection policy shared by route() and route_stream()."""
+        for name in self._selection_order(preferred, allow_fallback):
+            if not self._usable(name):
+                errors.append(f"{name}: unavailable or over budget")
+                continue
+            yield name, self.providers[name]
+
+    def _record_and_cache(
+        self, name, result: InferResult, agent, task_id, use_cache, cache_key
+    ) -> None:
+        self.budget.record(
+            name,
+            result.model,
+            result.input_tokens,
+            result.output_tokens,
+            agent=agent,
+            task_id=task_id,
+        )
+        if use_cache:
+            self.cache.put(cache_key, result)
+
     def route(
         self,
         prompt: str,
@@ -127,29 +150,117 @@ class RequestRouter:
                 return hit
 
         errors: List[str] = []
-        for name in self._selection_order(preferred, allow_fallback):
-            if not self._usable(name):
-                errors.append(f"{name}: unavailable or over budget")
-                continue
+        for name, provider in self._candidates(preferred, allow_fallback, errors):
             try:
-                result = self.providers[name].infer(
-                    prompt, system, max_tokens, temperature
-                )
+                result = provider.infer(prompt, system, max_tokens, temperature)
             except ProviderError as exc:
                 self.last_errors[name] = str(exc)
                 errors.append(f"{name}: {exc}")
                 if not allow_fallback:
                     break
                 continue
-            self.budget.record(
-                name,
-                result.model,
-                result.input_tokens,
-                result.output_tokens,
-                agent=agent,
-                task_id=task_id,
+            self._record_and_cache(
+                name, result, agent, task_id, use_cache, cache_key
             )
-            if use_cache:
-                self.cache.put(cache_key, result)
             return result
+        raise ProviderError("all providers failed: " + "; ".join(errors))
+
+    def route_stream(
+        self,
+        prompt: str,
+        system: str = "",
+        max_tokens: int = 1024,
+        temperature: float = 0.7,
+        preferred: str = "",
+        allow_fallback: bool = True,
+        agent: str = "",
+        task_id: str = "",
+        use_cache: bool = True,
+    ):
+        """Route with live streaming: yields (text_delta, provider_name).
+
+        Providers exposing ``stream_infer`` (the local TPU runtime) pipe
+        their token stream straight through — the first delta arrives while
+        generation is still running. Cloud providers without a streaming
+        client fall back to infer-then-rechunk (64-char pieces, matching
+        the reference's StreamInfer behavior). Fallback to the next
+        provider happens only before the first delta is emitted; after
+        that, a mid-stream failure surfaces to the caller.
+        """
+        cache_key = self.cache.key(prompt, system, max_tokens, temperature)
+        if use_cache:
+            hit = self.cache.get(cache_key)
+            if hit is not None:
+                for i in range(0, len(hit.text), 64):
+                    yield hit.text[i : i + 64], hit.provider
+                return
+
+        errors: List[str] = []
+        for name, provider in self._candidates(preferred, allow_fallback, errors):
+            if hasattr(provider, "stream_infer"):
+                # the runtime's incremental detokenizer emits ~one delta per
+                # generated token, so len(pieces) IS the completion token
+                # count; the chunk wire format carries no usage fields
+                # (runtime.proto InferChunk, reference parity). Recording
+                # happens in the finally so a client that disconnects
+                # mid-stream (GeneratorExit) still pays for what streamed;
+                # only COMPLETE responses enter the cache.
+                pieces: List[str] = []
+                completed = False
+                try:
+                    try:
+                        for delta in provider.stream_infer(
+                            prompt, system, max_tokens, temperature
+                        ):
+                            pieces.append(delta)
+                            yield delta, name
+                        completed = True
+                        if not pieces:
+                            # empty completion (immediate EOS): still hand
+                            # the consumer the serving provider's name so
+                            # the terminal done-chunk isn't unattributed
+                            yield "", name
+                    except ProviderError as exc:
+                        self.last_errors[name] = str(exc)
+                        if pieces:  # mid-stream failure: don't restart
+                            raise
+                        errors.append(f"{name}: {exc}")
+                        if not allow_fallback:
+                            break
+                        continue
+                finally:
+                    if pieces:
+                        self._record_and_cache(
+                            name,
+                            InferResult(
+                                text="".join(pieces),
+                                input_tokens=0,
+                                output_tokens=len(pieces),
+                                model=f"{name}-stream",
+                                provider=name,
+                            ),
+                            agent,
+                            task_id,
+                            use_cache and completed,
+                            cache_key,
+                        )
+                return
+            try:
+                result = provider.infer(prompt, system, max_tokens, temperature)
+            except ProviderError as exc:
+                self.last_errors[name] = str(exc)
+                errors.append(f"{name}: {exc}")
+                if not allow_fallback:
+                    break
+                continue
+            # record BEFORE yielding: the provider call is already paid for
+            # even if the client disconnects during the rechunk relay
+            self._record_and_cache(
+                name, result, agent, task_id, use_cache, cache_key
+            )
+            if not result.text:
+                yield "", name  # attribute the terminal chunk (see above)
+            for i in range(0, len(result.text), 64):
+                yield result.text[i : i + 64], name
+            return
         raise ProviderError("all providers failed: " + "; ".join(errors))

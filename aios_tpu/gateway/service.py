@@ -52,8 +52,13 @@ class GatewayService(ApiGatewayServicer):
         )
 
     def StreamInfer(self, request, context):
+        """True streaming: deltas are relayed as the serving provider emits
+        them (live token stream for the local TPU runtime; 64-char rechunk
+        only for providers without a streaming client — router.route_stream)."""
+        provider = ""
+        emitted = False
         try:
-            result = self.router.route(
+            for delta, provider in self.router.route_stream(
                 prompt=request.prompt,
                 system=request.system_prompt,
                 max_tokens=request.max_tokens or 1024,
@@ -62,19 +67,18 @@ class GatewayService(ApiGatewayServicer):
                 allow_fallback=request.allow_fallback,
                 agent=request.requesting_agent,
                 task_id=request.task_id,
-            )
+            ):
+                emitted = True
+                yield pb.StreamChunk(text=delta, done=False, provider=provider)
         except ProviderError as exc:
-            context.set_code(grpc.StatusCode.UNAVAILABLE)
-            context.set_details(str(exc))
+            if not emitted:
+                context.set_code(grpc.StatusCode.UNAVAILABLE)
+                context.set_details(str(exc))
+                return
+            context.set_code(grpc.StatusCode.ABORTED)
+            context.set_details(f"stream interrupted: {exc}")
             return
-        # chunked relay of the routed response
-        text = result.text
-        step = 64
-        for i in range(0, len(text), step):
-            yield pb.StreamChunk(
-                text=text[i : i + step], done=False, provider=result.provider
-            )
-        yield pb.StreamChunk(text="", done=True, provider=result.provider)
+        yield pb.StreamChunk(text="", done=True, provider=provider)
 
     def GetBudget(self, request, context):
         s = self.router.budget.status()
