@@ -65,6 +65,31 @@ log = logging.getLogger("aios.autonomy")
 MAX_ROUNDS = {REACTIVE: 1, OPERATIONAL: 1, TACTICAL: 3, STRATEGIC: 5}
 TOKEN_BUDGETS = {REACTIVE: 2048, OPERATIONAL: 2048, TACTICAL: 8192,
                  STRATEGIC: 16384}
+
+
+def _call_with_budget(backend, prompt: str, level: str, budget: int) -> str:
+    """Invoke an infer backend, passing the token budget when it takes one.
+
+    Production closures (orchestrator/main.py) have signature
+    (prompt, level, max_tokens); two-arg callables are grandfathered so
+    injected fakes keep working.
+    """
+    import inspect
+
+    try:
+        params = inspect.signature(backend).parameters.values()
+        positional = [
+            p for p in params
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ]
+        takes_budget = len(positional) >= 3 or any(
+            p.kind is p.VAR_POSITIONAL for p in params
+        )
+    except (TypeError, ValueError):
+        takes_budget = True
+    if takes_budget:
+        return backend(prompt, level, budget)
+    return backend(prompt, level)
 TOOL_RESULT_TRUNCATE = 1000
 MAX_AI_MESSAGES = 3  # awaiting_input cap (autonomy.rs:2431-2480)
 MAX_PARALLEL_AI = 3
@@ -370,12 +395,20 @@ class AutonomyLoop:
                     self._in_flight.discard(task.id)
 
     def _ai_infer(self, prompt: str, level: str) -> Optional[str]:
-        """gateway (preferred qwen3) -> runtime fallback chain."""
+        """gateway (preferred qwen3) -> runtime fallback chain.
+
+        Every call carries the per-level reasoning token budget
+        (TOKEN_BUDGETS; autonomy.rs:596-607 enforces 2048/2048/8192/16384
+        max_tokens by level) — backends forward it as the InferRequest /
+        ApiInferRequest max_tokens field. Two-arg backends (legacy tests,
+        simple fakes) are still accepted.
+        """
+        budget = TOKEN_BUDGETS.get(level, TOKEN_BUDGETS[OPERATIONAL])
         for backend in (self.gateway_infer, self.runtime_infer):
             if backend is None:
                 continue
             try:
-                return backend(prompt, level)
+                return _call_with_budget(backend, prompt, level, budget)
             except Exception as exc:  # noqa: BLE001
                 log.warning("AI backend failed: %s", exc)
                 continue

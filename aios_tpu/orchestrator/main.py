@@ -20,7 +20,7 @@ import grpc
 
 from ..proto_gen import api_gateway_pb2, memory_pb2, runtime_pb2, tools_pb2
 from .agent_router import AgentRouter
-from .autonomy import AutonomyConfig, AutonomyLoop
+from .autonomy import TOKEN_BUDGETS, AutonomyConfig, AutonomyLoop
 from .clients import HealthChecker, ServiceClients, ServiceRegistry
 from .cluster import ClusterManager, RemoteExecutor
 from .event_bus import EventBus, Subscription
@@ -29,7 +29,7 @@ from .management import ManagementConsole
 from .proactive import ProactiveGenerator
 from .scheduler import GoalScheduler
 from .service import OrchestratorService, serve
-from .task_planner import TaskPlanner
+from .task_planner import TACTICAL, TaskPlanner
 from .telemetry import DecisionLogger, ResultAggregator
 
 log = logging.getLogger("aios.orchestrator.main")
@@ -46,10 +46,13 @@ def build_orchestrator(
 
     # --- gRPC glue ---------------------------------------------------------
 
-    def gateway_infer(prompt: str, level: str = "") -> str:
+    def gateway_infer(prompt: str, level: str = "", max_tokens: int = 0) -> str:
+        """max_tokens carries the autonomy loop's per-level reasoning budget
+        (autonomy.TOKEN_BUDGETS; reference autonomy.rs:596-607)."""
         resp = clients.gateway.Infer(
             api_gateway_pb2.ApiInferRequest(
                 prompt=prompt,
+                max_tokens=max_tokens,
                 preferred_provider=(autonomy_config or AutonomyConfig()).preferred_provider,
                 allow_fallback=True,
                 requesting_agent="autonomy-loop",
@@ -58,10 +61,11 @@ def build_orchestrator(
         )
         return resp.text
 
-    def runtime_infer(prompt: str, level: str = "") -> str:
+    def runtime_infer(prompt: str, level: str = "", max_tokens: int = 0) -> str:
         resp = clients.runtime.Infer(
             runtime_pb2.InferRequest(
                 prompt=prompt,
+                max_tokens=max_tokens,
                 intelligence_level=level or "tactical",
                 requesting_agent="autonomy-loop",
             ),
@@ -121,9 +125,11 @@ def build_orchestrator(
 
     engine = GoalEngine(os.path.join(data_dir, "goals.db"))
     engine.recover()
+    # planner decomposition runs at the tactical budget (8192 tokens)
+    _plan_budget = TOKEN_BUDGETS[TACTICAL]
     planner = TaskPlanner(
-        gateway_infer=lambda p: gateway_infer(p),
-        runtime_infer=lambda p: runtime_infer(p),
+        gateway_infer=lambda p: gateway_infer(p, TACTICAL, _plan_budget),
+        runtime_infer=lambda p: runtime_infer(p, TACTICAL, _plan_budget),
     )
     router = AgentRouter()
     cluster = ClusterManager()

@@ -322,6 +322,30 @@ def test_heuristic_path_completes_goal():
     assert tools.calls[0][0] == "monitor.cpu"
 
 
+def test_reasoning_token_budget_per_level():
+    """Every AI call carries the per-level reasoning token budget
+    (autonomy.rs:596-607: 2048/2048/8192/16384), which the production
+    closures forward as InferRequest.max_tokens (orchestrator/main.py)."""
+    from aios_tpu.orchestrator.autonomy import TOKEN_BUDGETS
+
+    e = GoalEngine()
+    captured = []
+
+    def gateway(prompt, level, max_tokens):
+        captured.append((level, max_tokens))
+        return '{"thought": "ok", "tool_calls": [], "done": true}'
+
+    loop = _loop(e, gateway=gateway)
+    levels = ("reactive", "operational", "tactical", "strategic")
+    for level in levels:
+        assert loop._ai_infer("prompt", level) is not None
+    assert captured == [(lvl, TOKEN_BUDGETS[lvl]) for lvl in levels]
+
+    # two-arg backends (legacy fakes) are still accepted, budget elided
+    loop2 = _loop(e, gateway=lambda p, lvl: "plain")
+    assert loop2._ai_infer("prompt", "tactical") == "plain"
+
+
 def test_ai_reasoning_loop_multi_round():
     e = GoalEngine()
     tools = FakeTools()

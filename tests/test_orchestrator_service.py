@@ -168,3 +168,75 @@ def test_console_dashboard_and_api(console):
     assert "tasks" in tasks
     agents = _get(console + "/api/agents")
     assert "agents" in agents
+
+
+# ---------------------------------------------------------------------------
+# Standalone client + CLI (reference orchestrator_client.py:33-100)
+# ---------------------------------------------------------------------------
+
+
+def _client_for(orch_port):
+    from aios_tpu.orchestrator.client import ClientConfig, OrchestratorClient
+
+    return OrchestratorClient(
+        ClientConfig(address=f"127.0.0.1:{orch_port}", timeout_s=10,
+                     retry_delay_s=0.05)
+    )
+
+
+@pytest.fixture(scope="module")
+def orch_port():
+    server, service, port = serve(address="127.0.0.1:0", block=False)
+    yield port
+    server.stop(grace=None)
+
+
+def test_client_submit_status_cancel_roundtrip(orch_port):
+    with _client_for(orch_port) as client:
+        gid = client.submit_goal("client roundtrip goal", priority=4,
+                                 tags=["cli"], metadata={"k": "v"})
+        assert gid
+        status = client.get_goal_status(gid)
+        assert status["description"] == "client roundtrip goal"
+        goals = client.list_goals()
+        assert any(g["id"] == gid for g in goals)
+        assert client.cancel_goal(gid)
+        assert client.get_goal_status(gid)["status"] == "cancelled"
+        # wait_for_goal returns immediately on a terminal state
+        done = client.wait_for_goal(gid, timeout_s=5, poll_s=0.05)
+        assert done["status"] == "cancelled"
+        sysinfo = client.get_system_status()
+        assert "active_goals" in sysinfo
+        assert isinstance(client.list_agents(), list)
+
+
+def test_client_retries_then_raises_on_dead_server():
+    import grpc
+
+    from aios_tpu.orchestrator.client import ClientConfig, OrchestratorClient
+
+    client = OrchestratorClient(
+        ClientConfig(address="127.0.0.1:1", timeout_s=0.3, max_retries=2,
+                     retry_delay_s=0.01)
+    )
+    t0 = time.time()
+    with pytest.raises(grpc.RpcError):
+        client.get_system_status()
+    assert time.time() - t0 >= 0.01  # at least one retry delay elapsed
+
+
+def test_client_cli_submit_and_status(orch_port, capsys):
+    from aios_tpu.orchestrator import client as client_mod
+
+    rc = client_mod.main(
+        ["--address", f"127.0.0.1:{orch_port}", "submit", "cli goal"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["goal_id"]
+    rc = client_mod.main(
+        ["--address", f"127.0.0.1:{orch_port}", "status", out["goal_id"]]
+    )
+    assert rc == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["description"] == "cli goal"
