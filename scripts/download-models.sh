@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Fetch the GGUF weights for the local model tiers.
+#
+# TPU-native equivalent of /root/reference/scripts/download-models.sh: same
+# model set (the runtime's intelligence ladder, model_manager.rs:462-518),
+# same GGUF artifacts — the TPU runtime dequantizes GGUF into HBM-resident
+# int8/bf16 params at load (aios_tpu/engine/gguf.py) instead of handing the
+# file to llama.cpp.
+#
+# Usage: scripts/download-models.sh [--dest DIR] [--tier tiny|tactical|all]
+set -euo pipefail
+
+DEST=/var/lib/aios/models
+TIER=tiny
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --dest) DEST="$2"; shift 2 ;;
+    --tier) TIER="$2"; shift 2 ;;
+    *) echo "unknown flag: $1" >&2; exit 2 ;;
+  esac
+done
+
+mkdir -p "$DEST"
+
+# name|url|sha256 (sha256 empty = skip verification)
+TINY="tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|https://huggingface.co/TheBloke/TinyLlama-1.1B-Chat-v1.0-GGUF/resolve/main/tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|"
+MISTRAL="mistral-7b-instruct-v0.2.Q4_K_M.gguf|https://huggingface.co/TheBloke/Mistral-7B-Instruct-v0.2-GGUF/resolve/main/mistral-7b-instruct-v0.2.Q4_K_M.gguf|"
+
+case "$TIER" in
+  tiny)     MODELS=("$TINY") ;;
+  tactical) MODELS=("$MISTRAL") ;;
+  all)      MODELS=("$TINY" "$MISTRAL") ;;
+  *) echo "unknown tier: $TIER" >&2; exit 2 ;;
+esac
+
+for spec in "${MODELS[@]}"; do
+  IFS='|' read -r name url sha <<< "$spec"
+  out="$DEST/$name"
+  if [[ -f "$out" ]]; then
+    echo "[models] $name already present, skipping"
+    continue
+  fi
+  echo "[models] fetching $name"
+  curl -fL --retry 3 --retry-delay 5 -o "$out.part" "$url"
+  if [[ -n "$sha" ]]; then
+    echo "$sha  $out.part" | sha256sum -c -
+  fi
+  mv "$out.part" "$out"
+done
+
+echo "[models] done; $(ls -lh "$DEST" | tail -n +2 | wc -l) file(s) in $DEST"

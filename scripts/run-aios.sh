@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Launch the full aiOS-TPU stack via the boot supervisor (foreground).
+#
+# TPU-native equivalent of /root/reference/scripts/run-qemu.sh: the reference
+# boots its ISO in QEMU; here the five services boot as supervised host
+# processes on the TPU VM (aios_tpu/boot/supervisor.py — topo order, health
+# gates, restart caps).
+#
+# Usage: scripts/run-aios.sh [--data-dir DIR] [--model-dir DIR] [--cpu]
+set -euo pipefail
+
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --data-dir) export AIOS_DATA_DIR="$2"; shift 2 ;;
+    --model-dir) export AIOS_MODEL_DIR="$2"; shift 2 ;;
+    --cpu) export JAX_PLATFORMS=cpu; shift ;;
+    *) echo "unknown flag: $1" >&2; exit 2 ;;
+  esac
+done
+
+cd "$REPO_DIR"
+exec "${PYTHON:-python3}" -m aios_tpu.boot.supervisor
