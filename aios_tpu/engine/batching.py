@@ -117,6 +117,16 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self.completed = 0
         self.last_error: Optional[BaseException] = None
+        # If the engine went through its warmup gate, make sure OUR dispatch
+        # sizes are compiled too (warmup's defaults cover the default sizes;
+        # a non-default chunk_steps would otherwise compile for seconds on
+        # the scheduler thread at first dispatch, stalling live requests).
+        # A never-warmed engine (tests, lazy callers) is left lazy.
+        if engine._step_fns:
+            for n in {self.admit_chunk_steps, self.chunk_steps} - set(
+                engine._step_fns
+            ):
+                engine.step(n)
         self._thread = threading.Thread(
             target=self._run, name="continuous-batcher", daemon=True
         )
@@ -276,15 +286,15 @@ class ContinuousBatcher:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
-        # keep admission latency low when someone is waiting
+        # keep admission latency low when someone is waiting. n is always
+        # one of exactly TWO values — each step size is its own XLA graph,
+        # so clamping n to a data-dependent remaining-budget (as an earlier
+        # version did) triggers fresh multi-second compiles on this thread
+        # mid-serving; overshooting a request's max_tokens just produces
+        # ignored tokens, which costs microseconds instead
         with self._qlock:
             anyone_waiting = bool(self._waiting) or self._prefilling is not None
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
-        max_budget = min(
-            (l.req.max_tokens - l.produced for l in slots.values()),
-            default=n,
-        )
-        n = max(1, min(n, max_budget))
         tokens = self.engine.step(n)  # [n, num_slots]
         for step_row in tokens:
             for slot, live in list(slots.items()):

@@ -399,6 +399,25 @@ class TPUEngine:
     def slot_length(self, slot: int) -> int:
         return int(self._host_lengths[slot])
 
+    def close(self) -> None:
+        """Release device memory NOW. The jitted step fns close over
+        ``self`` (self._step_fns -> lambda -> self), so a dropped engine is
+        an uncollected reference CYCLE and its HBM survives until a gc pass
+        — on a 16 GB chip that breaks the next model load. Explicitly
+        breaking the cycle and dropping the arrays frees the buffers
+        deterministically (model_manager.unload_model and the bench rely on
+        this)."""
+        import gc
+
+        with self._lock:
+            self._step_fns.clear()
+            self._prefill_fns.clear()
+            self._chunk_fns.clear()
+            self.state = {}
+            self.params = None
+            self._attn_impl = None
+        gc.collect()
+
     # Admission granularity for long prompts; the batcher's default chunk
     # size and warmup's pre-compiled chunk graphs both read this, so the
     # production graphs and the readiness gate can't drift apart.
@@ -406,7 +425,12 @@ class TPUEngine:
 
     def warmup(
         self,
-        step_sizes: Tuple[int, ...] = (1, 8),
+        # must cover every step size the continuous batcher dispatches
+        # (admit_chunk_steps=2, chunk_steps=16) — a size missing here
+        # compiles for multiple seconds ON the scheduler thread at first
+        # use, stalling every live request (measured: ~2 s added to all 8
+        # agents' TTFT)
+        step_sizes: Tuple[int, ...] = (1, 2, 8, 16),
         prefill_chunk: Optional[int] = None,  # None -> prefill_chunk_default
     ) -> None:
         """Pre-compile decode + prefill buckets (LoadModel readiness gate —
@@ -420,7 +444,12 @@ class TPUEngine:
         the shared default, or 0 to skip.
         """
         for bucket in self.buckets:
-            self.prefill(0, [1] * min(4, bucket))
+            # length in (previous_bucket, bucket] so bucket_for() actually
+            # selects THIS bucket — a fixed short prompt would bucket to 16
+            # every iteration and leave the larger prefill graphs uncompiled
+            # (the readiness-gate bug the agent-TTFT bench exposed: the
+            # first real prompt then eats the compile mid-serving)
+            self.prefill(0, [1] * (bucket // 2 + 1), temperature=0.0)
             self.release(0)
         ck = self.prefill_chunk_default if prefill_chunk is None else prefill_chunk
         if not ck:

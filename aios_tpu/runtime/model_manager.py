@@ -301,7 +301,11 @@ class ModelManager:
         managed.state = STATE_UNLOADING
         if managed.batcher is not None:
             managed.batcher.shutdown()
-        # drop engine references; XLA frees HBM when arrays are collected
+        # engine.close() frees HBM deterministically — the jitted-step
+        # closures form a ref cycle with the engine, so plain deref would
+        # leave the weights resident until a gc pass
+        if managed.engine is not None:
+            managed.engine.close()
         managed.engine = None  # type: ignore[assignment]
         return True
 

@@ -86,6 +86,34 @@ def test_chunked_prefill_rejects_non_bucket_chunk(tiny_engine):
         tiny_engine.start_chunked_prefill(0, [1, 2, 3], chunk=48)
 
 
+def test_warmup_compiles_every_bucket_and_step_size():
+    """The readiness gate must leave NO graph uncompiled: a missing prefill
+    bucket or decode step size compiles for seconds on the scheduler thread
+    at first use (the regression behind the 2s agent TTFT: warmup's old
+    4-token prompt bucketed to 16 every iteration, so larger buckets were
+    never compiled)."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=2, max_context=128, cache_dtype=jnp.float32
+    )
+    engine.warmup(step_sizes=(1, 2), prefill_chunk=32)
+    assert set(engine._prefill_fns) == set(engine.buckets)
+    assert set(engine._step_fns) == {1, 2}
+    # chunked-admission graphs: the mid chunk and every final bucket <= 32
+    assert set(engine._chunk_fns) == {(32, False), (16, True), (32, True)}
+
+
+def test_close_releases_state():
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=2, max_context=128, cache_dtype=jnp.float32
+    )
+    engine.prefill(0, [1, 2, 3], temperature=0.0)
+    engine.close()
+    assert engine.state == {} and engine.params is None
+    assert not engine._prefill_fns and not engine._step_fns
+
+
 def test_generate_respects_stop_tokens(tiny_engine):
     prompt = [3, 17, 91, 4, 55, 8]
     free_run = tiny_engine.generate(prompt, max_new_tokens=10, temperature=0.0)
