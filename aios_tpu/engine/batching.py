@@ -88,10 +88,20 @@ class ContinuousBatcher:
         # the admit_chunk_steps fallback keeps admission latency low
         admit_chunk_steps: int = 2,
         prefill_chunk: Optional[int] = None,  # None -> the engine's default
+        speculative: bool = False,  # n-gram speculative decode dispatches
+        spec_draft_len: int = 7,
+        spec_ngram: int = 3,
     ) -> None:
         self.engine = engine
         self.chunk_steps = chunk_steps
         self.admit_chunk_steps = admit_chunk_steps
+        # Speculative dispatches (engine.spec_step) emit 1..draft_len+1
+        # tokens per slot per round — greedy requests decode the identical
+        # sequence in fewer dispatches (engine/spec.py); sampling requests
+        # transparently take their usual one token per round.
+        self.speculative = speculative
+        self.spec_draft_len = spec_draft_len
+        self.spec_ngram = spec_ngram
         # prompts longer than this admit incrementally (one cache-writing
         # chunk per scheduler pass) so a long admission never stalls decode
         # for the active slots; 0 disables. Defaults to the engine's
@@ -127,6 +137,16 @@ class ContinuousBatcher:
                 engine._step_fns
             ):
                 engine.step(n)
+            if self.speculative:
+                for n in {self.admit_chunk_steps, self.chunk_steps}:
+                    if (n, self.spec_draft_len, self.spec_ngram) not in (
+                        engine._spec_fns
+                    ):
+                        engine.spec_step(
+                            n,
+                            draft_len=self.spec_draft_len,
+                            ngram=self.spec_ngram,
+                        )
         self._thread = threading.Thread(
             target=self._run, name="continuous-batcher", daemon=True
         )
@@ -295,6 +315,21 @@ class ContinuousBatcher:
         with self._qlock:
             anyone_waiting = bool(self._waiting) or self._prefilling is not None
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
+        if self.speculative:
+            # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
+            # run in order; _emit retires requests mid-dispatch as usual
+            tokens, counts = self.engine.spec_step(
+                n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
+            )
+            for r in range(tokens.shape[0]):
+                for slot, live in list(slots.items()):
+                    if live.done:
+                        continue
+                    for j in range(int(counts[r, slot])):
+                        self._emit(live, int(tokens[r, slot, j]))
+                        if live.done:
+                            break
+            return
         tokens = self.engine.step(n)  # [n, num_slots]
         for step_row in tokens:
             for slot, live in list(slots.items()):

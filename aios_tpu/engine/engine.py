@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import model, sampling
+from . import model, sampling, spec
 from .config import ModelConfig
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
@@ -131,6 +131,10 @@ class TPUEngine:
             # cost no cache bandwidth in decode and write only to the
             # sacrificial last row (model.decode_step)
             "active": jnp.zeros((num_slots,), jnp.bool_),
+            # per-slot token history (prompt + generated) for device-side
+            # n-gram draft proposal (spec.py); history[s, :lengths[s]+1]
+            # mirrors cache rows + the pending last token
+            "history": spec.init_history(num_slots, self.max_context),
             "key": jax.random.PRNGKey(seed),
         }
         if self.quant_cache:
@@ -148,6 +152,7 @@ class TPUEngine:
         self._step_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[int, object] = {}
         self._chunk_fns: Dict[Tuple[int, bool], object] = {}
+        self._spec_fns: Dict[Tuple[int, int, int], object] = {}
         self.decode_steps = 0
 
     # -- jitted cores -------------------------------------------------------
@@ -181,6 +186,17 @@ class TPUEngine:
                     attn_impl=self._attn_impl,
                 )
             next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
+            slots = jnp.arange(self.num_slots)
+            # new token's history col is lengths+1 (<= C, inside the pad);
+            # inactive slots — retired or MID-CHUNKED-PREFILL — write to the
+            # sacrificial last pad col instead, or interleaved dispatches
+            # would scribble over prompt tokens the chunk admission already
+            # wrote (K/V has the same gate via the sacrificial cache row)
+            hcol = jnp.where(
+                st["active"],
+                st["lengths"] + 1,
+                st["history"].shape[1] - 1,
+            )
             st = {
                 "k": k,
                 "v": v,
@@ -189,6 +205,7 @@ class TPUEngine:
                 "temps": st["temps"],
                 "top_ps": st["top_ps"],
                 "active": st["active"],
+                "history": st["history"].at[slots, hcol].set(next_tokens),
                 "key": key,
             }
             if self.quant_cache:
@@ -198,6 +215,84 @@ class TPUEngine:
 
         state, tokens = jax.lax.scan(one, state, None, length=n_steps)
         return state, tokens  # tokens [n_steps, S]
+
+    def _spec_impl(
+        self, params, state: DecodeState, n_rounds: int, draft_len: int, ngram: int
+    ):
+        """R speculative rounds in one dispatch: propose n-gram drafts from
+        the device-resident history, verify them in a single multi-token
+        forward, accept the longest matching prefix (spec.py). Every slot
+        emits 1..draft_len+1 tokens per round; sampling (temp > 0) and
+        inactive slots degrade to exactly one plain decode step per round,
+        so this is a strict generalization of ``_step_impl``."""
+        S, C, K = self.num_slots, self.max_context, draft_len
+        slots = jnp.arange(S)
+
+        def one(st, _):
+            drafts, _num = spec.propose_ngram(
+                st["history"], st["lengths"], K, ngram, C
+            )
+            # only greedy, active slots speculate; everyone else verifies
+            # a row of -1 drafts (accept count 0 => plain decode step)
+            ok = (st["temps"] < sampling.GREEDY_EPS) & st["active"]
+            drafts = jnp.where(ok[:, None], drafts, -1)
+            feed = jnp.concatenate(
+                [st["last_tokens"][:, None], drafts], axis=1
+            )  # [S, K+1]
+            scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
+            out = model.verify_step(
+                params,
+                self.cfg,
+                feed,
+                st["lengths"],
+                st["k"],
+                st["v"],
+                cache_scales=scales,
+                active=st["active"],
+            )
+            if self.quant_cache:
+                logits, k, v, (k_s, v_s) = out
+            else:
+                logits, k, v = out
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+            a = spec.accept_counts(drafts, g)  # [S] in [0, K]
+            key, sub = jax.random.split(st["key"])
+            # row 0 == a plain decode step's logits; sample() takes argmax
+            # for greedy rows, so this covers both kinds of slot
+            first = sampling.sample(
+                logits[:, 0], sub, st["temps"], st["top_ps"]
+            )
+            out_tokens = g.at[:, 0].set(first)  # [S, K+1]
+            counts = a + 1  # tokens emitted this round per slot
+            new_last = jnp.take_along_axis(out_tokens, a[:, None], axis=1)[:, 0]
+            # accepted tokens land at history cols lengths+1 .. lengths+1+K
+            # (within the HISTORY_PAD margin — no clamp, no write collisions
+            # for active slots); inactive slots write the sacrificial last
+            # pad col so interleaved dispatches can't corrupt a
+            # mid-chunked-prefill slot's prompt history
+            hidx = jnp.where(
+                st["active"][:, None],
+                st["lengths"][:, None] + 1 + jnp.arange(K + 1)[None, :],
+                st["history"].shape[1] - 1,
+            )
+            st = {
+                "k": k,
+                "v": v,
+                "lengths": jnp.minimum(st["lengths"] + counts, C - 1),
+                "last_tokens": new_last,
+                "temps": st["temps"],
+                "top_ps": st["top_ps"],
+                "active": st["active"],
+                "history": st["history"].at[slots[:, None], hidx].set(out_tokens),
+                "key": key,
+            }
+            if self.quant_cache:
+                st["k_s"] = k_s
+                st["v_s"] = v_s
+            return st, (out_tokens, counts)
+
+        state, (tokens, counts) = jax.lax.scan(one, state, None, length=n_rounds)
+        return state, (tokens, counts)  # [R, S, K+1], [R, S]
 
     def _prefill_impl(
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p
@@ -228,6 +323,9 @@ class TPUEngine:
         key, sub = jax.random.split(state["key"])
         last = logits[0, true_len - 1][None, :]  # [1, V]
         first = sampling.sample(last, sub, temp[None], top_p[None])[0]
+        history = jax.lax.dynamic_update_slice(
+            state["history"], tokens, (slot, jnp.int32(0))
+        )
         out = {
             "k": k,
             "v": v,
@@ -236,6 +334,7 @@ class TPUEngine:
             "temps": state["temps"].at[slot].set(temp),
             "top_ps": state["top_ps"].at[slot].set(top_p),
             "active": state["active"].at[slot].set(True),
+            "history": history.at[slot, true_len].set(first),
             "key": key,
         }
         if self.quant_cache:
@@ -255,6 +354,9 @@ class TPUEngine:
             _, new["k"], new["v"], (new["k_s"], new["v_s"]) = out
         else:
             _, new["k"], new["v"] = out
+        new["history"] = jax.lax.dynamic_update_slice(
+            state["history"], tokens, (slot, start)
+        )
         return new
 
     def _final_chunk_impl(
@@ -276,11 +378,15 @@ class TPUEngine:
         key, sub = jax.random.split(state["key"])
         last = logits[0, n_valid - 1][None, :]  # [1, V]
         first = sampling.sample(last, sub, temp[None], top_p[None])[0]
+        history = jax.lax.dynamic_update_slice(
+            state["history"], tokens, (slot, start)
+        )
         new["lengths"] = state["lengths"].at[slot].set(true_len)
         new["last_tokens"] = state["last_tokens"].at[slot].set(first)
         new["temps"] = state["temps"].at[slot].set(temp)
         new["top_ps"] = state["top_ps"].at[slot].set(top_p)
         new["active"] = state["active"].at[slot].set(True)
+        new["history"] = history.at[slot, true_len].set(first)
         new["key"] = key
         return new, first
 
@@ -298,6 +404,16 @@ class TPUEngine:
         if fn is None:
             fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
             self._prefill_fns[bucket] = fn
+        return fn
+
+    def _spec_fn(self, n_rounds: int, draft_len: int, ngram: int):
+        key = (n_rounds, draft_len, ngram)
+        fn = self._spec_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, s: self._spec_impl(p, s, *key), donate_argnums=(1,)
+            )
+            self._spec_fns[key] = fn
         return fn
 
     def _chunk_fn(self, bucket: int, final: bool):
@@ -389,6 +505,38 @@ class TPUEngine:
             )
             return np.asarray(tokens)
 
+    def spec_step(
+        self, n_rounds: int = 8, draft_len: int = 7, ngram: int = 3
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run ``n_rounds`` speculative decode rounds in one dispatch.
+
+        Returns (tokens [n_rounds, num_slots, draft_len+1],
+        counts [n_rounds, num_slots]): in round r, slot s emitted the first
+        ``counts[r, s]`` entries of ``tokens[r, s]`` — at least 1 (a plain
+        decode step's token), up to ``draft_len+1`` when the whole n-gram
+        draft was accepted. Greedy slots emit exactly the plain-greedy
+        sequence; temp>0 slots never speculate and emit 1 sampled
+        token/round. Only columns where ``self.active`` are meaningful.
+        """
+        # upper bound keeps active slots' history writes strictly below the
+        # sacrificial last pad column reserved for inactive slots
+        if not 1 <= draft_len <= spec.HISTORY_PAD - 2:
+            raise ValueError(
+                f"draft_len must be in [1, {spec.HISTORY_PAD - 2}]"
+            )
+        if ngram < 1:
+            raise ValueError("ngram must be >= 1")
+        with self._lock:
+            self.state, (tokens, counts) = self._spec_fn(
+                n_rounds, draft_len, ngram
+            )(self.params, self.state)
+            self.decode_steps += n_rounds
+            counts = np.asarray(counts)
+            self._host_lengths = np.minimum(
+                self._host_lengths + counts.sum(axis=0), self.max_context - 1
+            )
+            return np.asarray(tokens), counts
+
     def release(self, slot: int) -> None:
         self.active[slot] = False
         self._host_lengths[slot] = 0
@@ -413,6 +561,7 @@ class TPUEngine:
             self._step_fns.clear()
             self._prefill_fns.clear()
             self._chunk_fns.clear()
+            self._spec_fns.clear()
             self.state = {}
             self.params = None
             self._attn_impl = None
@@ -478,9 +627,15 @@ class TPUEngine:
         stop_tokens: Tuple[int, ...] = (),
         slot: int = 0,
         chunk: int = 8,
+        speculative: bool = False,
+        draft_len: int = 7,
+        ngram: int = 3,
     ) -> List[int]:
         """Single-request generation loop (the continuous-batching scheduler
-        in engine/batching.py is the production path)."""
+        in engine/batching.py is the production path). ``speculative=True``
+        decodes via n-gram speculative rounds (spec.py) — identical greedy
+        output, fewer dispatches; sampling requests fall back to plain
+        stepping on their own."""
         first = self.prefill(slot, token_ids, temperature, top_p)
         out = [first]
         while len(out) < max_new_tokens and out[-1] not in stop_tokens:
@@ -488,11 +643,30 @@ class TPUEngine:
             room = self.max_context - 1 - self.slot_length(slot)
             if room <= 0:
                 break
-            toks = self.step(min(budget, room))[:, slot]
-            for t in toks.tolist():
+            if speculative:
+                pre = self.slot_length(slot)  # before the dispatch mutates it
+                toks, counts = self.spec_step(
+                    min(budget, room), draft_len=draft_len, ngram=ngram
+                )
+                flat: List[int] = []
+                for r in range(toks.shape[0]):
+                    if pre >= self.max_context - 1:
+                        # slot saturated mid-dispatch: later rounds' cache
+                        # writes collapse onto the last row (verify_step's
+                        # scatter contract) — their tokens are indeterminate
+                        # and must not be consumed
+                        break
+                    flat.extend(int(t) for t in toks[r, slot, : counts[r, slot]])
+                    pre += int(counts[r, slot])
+                toks = flat
+            else:
+                toks = self.step(min(budget, room))[:, slot].tolist()
+            for t in toks:
                 out.append(int(t))
                 if t in stop_tokens:
                     break
+            if len(out) > max_new_tokens:  # speculative overshoot
+                del out[max_new_tokens:]
         self.release(slot)
         if stop_tokens:
             for i, t in enumerate(out):

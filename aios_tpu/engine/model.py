@@ -653,6 +653,117 @@ def decode_step(
     return logits, k_cache, v_cache
 
 
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 — [last_token, draft_0..draft_{T-2}]
+    lengths: jnp.ndarray,  # [B] int32 — tokens already in each slot's cache
+    k_cache: jnp.ndarray,  # [L, B, C, KH, D]
+    v_cache: jnp.ndarray,  # [L, B, C, KH, D]
+    cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    active: Optional[jnp.ndarray] = None,  # [B] bool
+):
+    """Batched multi-token decode for speculative verification.
+
+    The T tokens per slot are the pending ``last_token`` followed by T-1
+    draft tokens; all T K/V rows are written at rows
+    ``lengths[b] .. lengths[b]+T-1`` in one pass and every row of logits
+    comes back, so the caller can accept the longest draft prefix that
+    matches the model's own predictions (engine/spec.py). Because batched
+    decode is weight-bandwidth-bound, verifying T positions costs roughly
+    the same HBM traffic as a 1-token decode step — accepted drafts are
+    nearly free tokens. This is the TPU replacement for the speculative /
+    lookahead decoding the reference's llama.cpp backend exposes via
+    llama-server's ``--draft`` options (SURVEY.md section 2.3).
+
+    Same conventions as ``decode_step``: ``active`` gating writes inactive
+    slots' rows to the sacrificial last cache row and exposes zero cache
+    rows to them; ``cache_scales`` marks an int8 KV cache. Queries attend
+    causally: query t of slot b sees cache cols ``<= lengths[b]+t`` (its own
+    row included — written before the read), inside the sliding window.
+
+    Rows written past ``C-2`` collapse onto the last cache row (scatter
+    order is undefined there) — callers must clamp draft counts so accepted
+    rows stay ``<= C-2``; unaccepted garbage rows are masked by ``lengths``
+    afterwards. A slot already AT ``lengths == C-1`` collapses all T writes
+    (including row 0's) onto the raced last row, so its outputs are
+    indeterminate: callers must not consume tokens from saturated slots
+    (the batcher retires them at the cache end; ``generate`` stops
+    consuming mid-dispatch). Returns (logits [B, T, V] fp32, k_cache',
+    v_cache'[, scales']).
+    """
+    B, T = tokens.shape
+    C = k_cache.shape[2]
+    quant_cache = cache_scales is not None
+    if active is None:
+        active = jnp.ones((B,), jnp.bool_)
+    offs = jnp.arange(T)[None, :]  # [1, T]
+    # absolute position of each query row (garbage for inactive slots)
+    positions = lengths[:, None] + offs  # [B, T]
+    write_rows = jnp.where(
+        active[:, None], jnp.minimum(positions, C - 1), C - 1
+    )  # [B, T]
+    # inactive slots expose only (overwritten-before-read) col 0, matching
+    # the decode_step convention
+    qpos = jnp.where(active[:, None], positions, 0)  # [B, T]
+    cols = jnp.arange(C)[None, None, :]  # [1, 1, C]
+    mask = cols <= qpos[..., None]  # [B, T, C]
+    if cfg.sliding_window is not None:
+        mask = mask & (cols > (qpos[..., None] - cfg.sliding_window))
+
+    x = params["embed"][tokens]  # [B, T, E]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    batch_idx = jnp.arange(B)[:, None]  # [B, 1] pairs with write_rows [B, T]
+
+    def block(x, layer):
+        if quant_cache:
+            lp, k_l, v_l, k_s, v_s = layer
+        else:
+            lp, k_l, v_l = layer
+            k_s = v_s = None
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        if quant_cache:
+            kq, ks_new = quantize_kv(k_new)  # [B, T, KH, D], [B, T, KH]
+            vq, vs_new = quantize_kv(v_new)
+            k_l = k_l.at[batch_idx, write_rows].set(kq)
+            v_l = v_l.at[batch_idx, write_rows].set(vq)
+            k_s = k_s.at[batch_idx, write_rows].set(ks_new)
+            v_s = v_s.at[batch_idx, write_rows].set(vs_new)
+            attn = gqa_attention(
+                q,
+                dequantize_kv(k_l, k_s, q.dtype),
+                dequantize_kv(v_l, v_s, q.dtype),
+                mask,
+            )
+        else:
+            k_l = k_l.at[batch_idx, write_rows].set(k_new.astype(k_l.dtype))
+            v_l = v_l.at[batch_idx, write_rows].set(v_new.astype(v_l.dtype))
+            attn = gqa_attention(q, k_l, v_l, mask)
+        x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
+        x = x + _mlp(x, lp, cfg)
+        if quant_cache:
+            return x, (k_l, v_l, k_s, v_s)
+        return x, (k_l, v_l)
+
+    if quant_cache:
+        k_scales, v_scales = cache_scales
+        x, (k_cache, v_cache, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["layers"], k_cache, v_cache, k_scales, v_scales)
+        )
+    else:
+        x, (k_cache, v_cache) = jax.lax.scan(
+            block, x, (params["layers"], k_cache, v_cache)
+        )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = matmul(x, head).astype(jnp.float32)
+    if quant_cache:
+        return logits, k_cache, v_cache, (k_scales, v_scales)
+    return logits, k_cache, v_cache
+
+
 # ---------------------------------------------------------------------------
 # Initialization
 # ---------------------------------------------------------------------------

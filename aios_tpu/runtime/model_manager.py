@@ -142,6 +142,13 @@ class ModelManager:
                 "using bf16",
                 kv_env,
             )
+        # AIOS_TPU_SPECULATIVE=1 turns on n-gram speculative decode
+        # dispatches (engine/spec.py): greedy agent requests — tool-call
+        # JSON, quoted context — emit several tokens per verify round with
+        # identical output. Off by default until measured per deployment.
+        self.speculative = os.environ.get(
+            "AIOS_TPU_SPECULATIVE", ""
+        ).lower() in ("1", "true", "on")
         self._lock = threading.Lock()
 
     # -- loading ------------------------------------------------------------
@@ -172,7 +179,7 @@ class ModelManager:
             del params
             if self.warm_compile:
                 engine.warmup()
-            batcher = ContinuousBatcher(engine)
+            batcher = ContinuousBatcher(engine, speculative=self.speculative)
             managed = ManagedModel(
                 name=name,
                 config=cfg,
