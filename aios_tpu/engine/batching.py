@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .engine import ChunkedPrefill, TPUEngine
+from .paged import PoolExhausted
 
 log = logging.getLogger("aios.batcher")
 
@@ -114,8 +115,13 @@ class ContinuousBatcher:
         if self.prefill_chunk is not None and (
             self.prefill_chunk not in engine.buckets
             or engine.max_context % self.prefill_chunk
+            or engine.paged  # chunked admission unsupported on paged v1
         ):
             self.prefill_chunk = None
+        # paged engines can run out of physical KV pages mid-stream; the
+        # policy is to retire the LONGEST request (it has produced the most
+        # and frees the most pages) and retry — counted for observability
+        self.pool_evictions = 0
         self._waiting: "deque[_Live]" = deque()
         self._qlock = threading.Lock()
         self._prefilling: Optional[Tuple[_Live, ChunkedPrefill]] = None
@@ -211,6 +217,20 @@ class ContinuousBatcher:
             slot = free[0]
             live.slot = slot
             ids = live.req.prompt_ids
+            alloc = self.engine.allocator
+            if alloc is not None and alloc.blocks_for(
+                min(len(ids), self.engine.max_context - 1)
+            ) > alloc.num_pages - 1:
+                # the prompt can NEVER fit the pool — fail it up front;
+                # evicting live requests one per tick would truncate every
+                # co-resident stream before reaching the same conclusion
+                log.warning(
+                    "request %s prompt (%d tokens) exceeds the whole KV "
+                    "page pool; failing it", live.req.request_id, len(ids),
+                )
+                live.done = True
+                live.out_q.put(_END)
+                continue
             chunked = self.prefill_chunk is not None and len(ids) > self.prefill_chunk
             if chunked:
                 if self._prefilling is not None:
@@ -230,12 +250,24 @@ class ContinuousBatcher:
                 )
                 self._reserved_slot = slot
                 continue
-            first = self.engine.prefill(
-                slot,
-                ids,
-                temperature=live.req.temperature,
-                top_p=live.req.top_p,
-            )
+            try:
+                first = self.engine.prefill(
+                    slot,
+                    ids,
+                    temperature=live.req.temperature,
+                    top_p=live.req.top_p,
+                )
+            except PoolExhausted:
+                with self._qlock:
+                    self._waiting.appendleft(live)  # keep FIFO order
+                if not self._evict_longest():
+                    # nothing to evict: the prompt is bigger than the whole
+                    # pool — fail just this request, not the scheduler
+                    with self._qlock:
+                        self._waiting.popleft()
+                    live.done = True
+                    live.out_q.put(_END)
+                return
             live.first_token_at = time.monotonic()
             with self._lock:
                 self._live[slot] = live
@@ -261,6 +293,28 @@ class ContinuousBatcher:
         # _END goes last: when a consumer unblocks, all scheduler-side state
         # (slot freed, counters bumped) is already final
         live.out_q.put(_END)
+
+    def _evict_longest(self) -> bool:
+        """Retire the live request with the most cache rows (frees the most
+        pages) so a pool-exhausted dispatch can make progress. Returns
+        False when there is nothing to evict."""
+        with self._lock:
+            victims = sorted(
+                self._live.values(),
+                key=lambda l: self.engine.slot_length(l.slot),
+            )
+        if not victims:
+            return False
+        victim = victims[-1]
+        log.warning(
+            "KV page pool exhausted; retiring longest request %s "
+            "(%d rows) to free pages",
+            victim.req.request_id,
+            self.engine.slot_length(victim.slot),
+        )
+        self.pool_evictions += 1
+        self._finish(victim)
+        return True
 
     def _abort_all(self, exc: BaseException) -> None:
         """A scheduler-thread failure must surface, not strand callers: every
@@ -330,7 +384,13 @@ class ContinuousBatcher:
                         if live.done:
                             break
             return
-        tokens = self.engine.step(n)  # [n, num_slots]
+        try:
+            tokens = self.engine.step(n)  # [n, num_slots]
+        except PoolExhausted:
+            # retire the longest request and retry on the next tick; the
+            # failed ensure() left all engine state untouched
+            self._evict_longest()
+            return
         for step_row in tokens:
             for slot, live in list(slots.items()):
                 if live.done:

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import model, sampling, spec
+from . import model, paged, sampling, spec
 from .config import ModelConfig
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
@@ -56,6 +56,8 @@ class TPUEngine:
         shardings=None,  # optional ShardingPlan (aios_tpu.parallel.sharding)
         quantize: bool = False,  # int8 serving weights
         sharded_attention: Optional[bool] = None,  # shard_map ragged decode
+        paged_pool_rows: Optional[int] = None,  # physical KV rows -> paged
+        page_size: int = 128,
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -117,7 +119,37 @@ class TPUEngine:
                     cfg.sliding_window, use_kernel=on_tpu
                 )
 
-        k, v = model.init_kv_cache(cfg, num_slots, self.max_context, cache_dtype)
+        # Paged KV cache: HBM is reserved per page IN USE, not per
+        # num_slots x max_context — many long-context slots oversubscribe a
+        # fixed pool (SURVEY.md section 7.2). Logical layout and outputs are
+        # identical to the dense cache; the page indirection lives in
+        # engine/paged.py (tables) + ops/paged_attention.py (reads).
+        self.paged = paged_pool_rows is not None
+        self.allocator: Optional[paged.PageAllocator] = None
+        if self.paged:
+            if shardings is not None:
+                raise ValueError("paged KV cache is single-chip for now")
+            if self.quant_cache:
+                raise ValueError("paged KV cache requires a bf16/f32 cache")
+            if self.max_context % page_size:
+                raise ValueError(
+                    f"max_context {self.max_context} must be a multiple of "
+                    f"page_size {page_size}"
+                )
+            max_blocks = self.max_context // page_size
+            num_pages = 1 + max(1, -(-int(paged_pool_rows) // page_size))
+            self.allocator = paged.PageAllocator(
+                num_pages, page_size, num_slots, max_blocks
+            )
+            shape = (
+                cfg.num_layers, num_pages, page_size,
+                cfg.num_kv_heads, cfg.head_dim,
+            )
+            k, v = jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype)
+        else:
+            k, v = model.init_kv_cache(
+                cfg, num_slots, self.max_context, cache_dtype
+            )
         if shardings is not None:
             k, v = shardings.put_cache(k), shardings.put_cache(v)
         self.state: DecodeState = {
@@ -157,11 +189,29 @@ class TPUEngine:
 
     # -- jitted cores -------------------------------------------------------
 
-    def _step_impl(self, params, state: DecodeState, n_steps: int):
+    def _step_impl(self, params, state: DecodeState, n_steps: int, tables=None):
+        """The decode scan. ``tables`` (paged engines only) is the host
+        allocator's [S, MB] block->page map riding along with the dispatch;
+        only the model call differs between the dense, int8-KV and paged
+        cache layouts — sampling, history gating and the state rebuild are
+        shared."""
+
         def one(carry, _):
             st = carry
             key, sub = jax.random.split(st["key"])
-            if self.quant_cache:
+            if self.paged:
+                logits, k, v = model.decode_step_paged(
+                    params,
+                    self.cfg,
+                    st["last_tokens"],
+                    st["lengths"],
+                    st["k"],
+                    st["v"],
+                    tables,
+                    kernels=self._kernels,
+                    active=st["active"],
+                )
+            elif self.quant_cache:
                 logits, k, v, (k_s, v_s) = model.decode_step(
                     params,
                     self.cfg,
@@ -294,6 +344,45 @@ class TPUEngine:
         state, (tokens, counts) = jax.lax.scan(one, state, None, length=n_rounds)
         return state, (tokens, counts)  # [R, S, K+1], [R, S]
 
+    def _prefill_impl_paged(
+        self, params, state: DecodeState, tokens, slot, true_len, temp, top_p,
+        table_row,
+    ):
+        """Paged twin of ``_prefill_impl``: the prompt's K/V rows scatter
+        into the page pool through ``table_row`` (the slot's block->page
+        map; rows in unbacked blocks land on the sacrificial page 0 and are
+        never read)."""
+        logits, ks, vs = model.prefill(
+            params, self.cfg, tokens, kernels=self._kernels
+        )
+        T = tokens.shape[1]
+        P = state["k"].shape[2]
+        nb = -(-T // P)  # blocks this bucket spans (static)
+        # static repeat, not table_row[rows // P]: an index-array gather
+        # serializes on TPU (same lesson as spec.propose_ngram)
+        pages = jnp.repeat(table_row[:nb], P)[:T]  # [T]
+        offs = jnp.arange(T) % P
+        # ks/vs [L, 1, T, KH, D] -> pool [L, N, P, KH, D]
+        k = state["k"].at[:, pages, offs].set(ks[:, 0].astype(state["k"].dtype))
+        v = state["v"].at[:, pages, offs].set(vs[:, 0].astype(state["v"].dtype))
+        key, sub = jax.random.split(state["key"])
+        last = logits[0, true_len - 1][None, :]  # [1, V]
+        first = sampling.sample(last, sub, temp[None], top_p[None])[0]
+        history = jax.lax.dynamic_update_slice(
+            state["history"], tokens, (slot, jnp.int32(0))
+        )
+        return {
+            "k": k,
+            "v": v,
+            "lengths": state["lengths"].at[slot].set(true_len),
+            "last_tokens": state["last_tokens"].at[slot].set(first),
+            "temps": state["temps"].at[slot].set(temp),
+            "top_ps": state["top_ps"].at[slot].set(top_p),
+            "active": state["active"].at[slot].set(True),
+            "history": history.at[slot, true_len].set(first),
+            "key": key,
+        }, first
+
     def _prefill_impl(
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p
     ):
@@ -393,16 +482,24 @@ class TPUEngine:
     def _step_fn(self, n_steps: int):
         fn = self._step_fns.get(n_steps)
         if fn is None:
-            fn = jax.jit(
-                lambda p, s: self._step_impl(p, s, n_steps), donate_argnums=(1,)
-            )
+            if self.paged:
+                fn = jax.jit(
+                    lambda p, s, t: self._step_impl(p, s, n_steps, t),
+                    donate_argnums=(1,),
+                )
+            else:
+                fn = jax.jit(
+                    lambda p, s: self._step_impl(p, s, n_steps),
+                    donate_argnums=(1,),
+                )
             self._step_fns[n_steps] = fn
         return fn
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
+            impl = self._prefill_impl_paged if self.paged else self._prefill_impl
+            fn = jax.jit(impl, donate_argnums=(1,))
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -455,7 +552,7 @@ class TPUEngine:
         padded[0, :true_len] = token_ids
 
         with self._lock:
-            self.state, first = self._prefill_fn(bucket)(
+            args = [
                 self.params,
                 self.state,
                 jnp.asarray(padded),
@@ -463,7 +560,14 @@ class TPUEngine:
                 jnp.int32(true_len),
                 jnp.float32(temperature),
                 jnp.float32(top_p),
-            )
+            ]
+            if self.paged:
+                # back the prompt's rows NOW (raises PoolExhausted before
+                # any state is touched); the bucket's padding rows beyond
+                # true_len land on the sacrificial page and are never read
+                self.allocator.ensure(slot, true_len)
+                args.append(jnp.asarray(self.allocator.tables[slot]))
+            self.state, first = self._prefill_fn(bucket)(*args)
             self.active[slot] = True
             self._host_lengths[slot] = true_len
             return int(first)
@@ -483,6 +587,11 @@ class TPUEngine:
         max_context so chunk writes never spill past the cache end."""
         if not 0 <= slot < self.num_slots:
             raise ValueError(f"slot {slot} out of range")
+        if self.paged:
+            raise ValueError(
+                "chunked prefill is not supported on a paged engine yet; "
+                "admit monolithically (batching.py auto-disables chunking)"
+            )
         if chunk not in self.buckets or self.max_context % chunk:
             raise ValueError(
                 f"chunk {chunk} must be a prefill bucket dividing "
@@ -498,7 +607,26 @@ class TPUEngine:
         (fixed-shape graph), clamped at the cache end.
         """
         with self._lock:
-            self.state, tokens = self._step_fn(n_steps)(self.params, self.state)
+            if self.paged:
+                # back every active slot's next n rows BEFORE dispatching;
+                # PoolExhausted surfaces here (state untouched) so the
+                # batcher can retire a victim and retry
+                for s in range(self.num_slots):
+                    if self.active[s]:
+                        self.allocator.ensure(
+                            s,
+                            min(
+                                int(self._host_lengths[s]) + n_steps,
+                                self.max_context,
+                            ),
+                        )
+                self.state, tokens = self._step_fn(n_steps)(
+                    self.params, self.state, jnp.asarray(self.allocator.tables)
+                )
+            else:
+                self.state, tokens = self._step_fn(n_steps)(
+                    self.params, self.state
+                )
             self.decode_steps += n_steps
             self._host_lengths = np.minimum(
                 self._host_lengths + n_steps, self.max_context - 1
@@ -518,6 +646,10 @@ class TPUEngine:
         sequence; temp>0 slots never speculate and emit 1 sampled
         token/round. Only columns where ``self.active`` are meaningful.
         """
+        if self.paged:
+            raise ValueError(
+                "speculative decoding is not supported on a paged engine yet"
+            )
         # upper bound keeps active slots' history writes strictly below the
         # sacrificial last pad column reserved for inactive slots
         if not 1 <= draft_len <= spec.HISTORY_PAD - 2:
@@ -541,6 +673,8 @@ class TPUEngine:
         self.active[slot] = False
         self._host_lengths[slot] = 0
         with self._lock:
+            if self.allocator is not None:
+                self.allocator.free_slot(slot)  # pages recycle instantly
             self.state["lengths"] = self.state["lengths"].at[slot].set(0)
             self.state["active"] = self.state["active"].at[slot].set(False)
 
@@ -593,6 +727,10 @@ class TPUEngine:
         the shared default, or 0 to skip.
         """
         for bucket in self.buckets:
+            if self.paged and self.allocator.blocks_for(
+                bucket // 2 + 1
+            ) > self.allocator.free_pages:
+                continue  # pool can't back prompts of this bucket anyway
             # length in (previous_bucket, bucket] so bucket_for() actually
             # selects THIS bucket — a fixed short prompt would bucket to 16
             # every iteration and leave the larger prefill graphs uncompiled
@@ -600,6 +738,8 @@ class TPUEngine:
             # first real prompt then eats the compile mid-serving)
             self.prefill(0, [1] * (bucket // 2 + 1), temperature=0.0)
             self.release(0)
+        if self.paged:
+            prefill_chunk = 0  # chunked admission unsupported on paged v1
         ck = self.prefill_chunk_default if prefill_chunk is None else prefill_chunk
         if not ck:
             ck = None

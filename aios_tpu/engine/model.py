@@ -354,6 +354,16 @@ def _use_kernels(kernels: Optional[bool]) -> bool:
     return ops.use_pallas() if kernels is None else bool(kernels)
 
 
+def _final_logits(x: jnp.ndarray, params: Params, cfg: ModelConfig):
+    """Shared tail of every entry point: final RMSNorm + (possibly tied,
+    possibly int8) lm_head matmul; logits in fp32."""
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return matmul(x, head).astype(jnp.float32)
+
+
 def _ragged_min_c() -> int:
     """Cache length where the ragged decode kernel starts winning over
     XLA's fused full-cache read (measured crossover on v5e ~2k rows;
@@ -389,11 +399,7 @@ def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=Non
         return apply_block(x, lp, cfg, cos, sin, mask, attention)
 
     x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = matmul(x, head).astype(jnp.float32)
+    logits = _final_logits(x, params, cfg)
     return logits, ks, vs
 
 
@@ -502,11 +508,7 @@ def prefill_chunk(
         x, (k_cache, v_cache) = jax.lax.scan(
             block, x, (params["layers"], k_cache, v_cache)
         )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = matmul(x, head).astype(jnp.float32)
+    logits = _final_logits(x, params, cfg)
     if quant_cache:
         return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache
@@ -643,14 +645,80 @@ def decode_step(
         x, (k_cache, v_cache) = jax.lax.scan(
             block, x, (params["layers"], k_cache, v_cache)
         )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = matmul(x[:, 0], head).astype(jnp.float32)
+    logits = _final_logits(x[:, 0], params, cfg)
     if quant_cache:
         return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache
+
+
+def decode_step_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] int32 — one new token per slot
+    lengths: jnp.ndarray,  # [B] int32 — logical rows already in each slot
+    k_pool: jnp.ndarray,  # [L, N, P, KH, D] — shared page pool
+    v_pool: jnp.ndarray,  # [L, N, P, KH, D]
+    tables: jnp.ndarray,  # [B, MB] int32 — logical block -> physical page
+    kernels: Optional[bool] = None,
+    active: Optional[jnp.ndarray] = None,  # [B] bool
+):
+    """One batched decode step over the PAGED slot cache.
+
+    Identical contract to ``decode_step`` except K/V rows live in a shared
+    page pool read through per-slot tables (ops/paged_attention.py): the
+    new row is scattered to (page ``tables[b, lengths[b] // P]``, offset
+    ``lengths[b] % P``), and attention reads only the pages that hold valid
+    rows. Inactive slots write the sacrificial page 0 (paged.py) and read
+    zero rows. The caller must have BACKED row ``lengths[b]`` for every
+    active slot (PageAllocator.ensure) — an unbacked entry maps page 0 and
+    would silently cross-talk through the sacrificial page.
+
+    Returns (logits [B, V] fp32, k_pool', v_pool').
+    """
+    B = tokens.shape[0]
+    P = k_pool.shape[2]
+    use_kernel = _use_kernels(kernels)
+    if active is None:
+        write_pages_of = lengths
+        read_lengths = lengths
+        act = jnp.ones((B,), jnp.bool_)
+    else:
+        act = active
+        write_pages_of = jnp.where(active, lengths, 0)
+        read_lengths = jnp.where(active, lengths, 0)
+    blk = write_pages_of // P
+    pages = jnp.where(
+        act, jnp.take_along_axis(tables, blk[:, None], axis=1)[:, 0], 0
+    )
+    offs = jnp.where(act, write_pages_of % P, P - 1)
+
+    x = params["embed"][tokens][:, None, :]  # [B, 1, E]
+    cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
+
+    def block(x, layer):
+        lp, k_l, v_l = layer
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        k_l = k_l.at[pages, offs].set(k_new[:, 0].astype(k_l.dtype))
+        v_l = v_l.at[pages, offs].set(v_new[:, 0].astype(v_l.dtype))
+        if use_kernel:
+            attn = ops.paged_decode_attention(
+                q[:, 0], k_l, v_l, tables, read_lengths,
+                window=cfg.sliding_window,
+            )[:, None]
+        else:
+            attn = ops.paged_decode_attention_reference(
+                q[:, 0], k_l, v_l, tables, read_lengths,
+                window=cfg.sliding_window,
+            )[:, None]
+        x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
+        x = x + _mlp(x, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        block, x, (params["layers"], k_pool, v_pool)
+    )
+    logits = _final_logits(x[:, 0], params, cfg)
+    return logits, k_pool, v_pool
 
 
 def verify_step(
@@ -754,11 +822,7 @@ def verify_step(
         x, (k_cache, v_cache) = jax.lax.scan(
             block, x, (params["layers"], k_cache, v_cache)
         )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = matmul(x, head).astype(jnp.float32)
+    logits = _final_logits(x, params, cfg)
     if quant_cache:
         return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache

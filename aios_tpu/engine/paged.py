@@ -1,0 +1,97 @@
+"""Host-side page-table management for the paged KV cache.
+
+The device holds a fixed page pool ([L, N, P, KH, D] per k/v) and reads it
+through per-slot page tables; THIS module owns the mapping. Allocation is a
+free-list pop, release a push — O(1), no compaction, no device traffic
+beyond the [S, MAX_BLOCKS] int32 table that rides along with each dispatch
+(a few hundred bytes). The scheduler's admission/retire cycle calls
+`ensure`/`free_slot`; a pool that can't back a grow request raises
+`PoolExhausted` so the batcher can retire a victim request instead of
+corrupting anyone's cache.
+
+Page 0 is reserved as the *sacrificial page*: never allocated, mapped by
+every unbacked table entry, and the write target for inactive slots — the
+paged twin of the dense engine's sacrificial last cache row.
+
+Reference equivalence: llama.cpp's per-sequence KV cells behind
+llama-server (SURVEY.md section 2.3); redesigned as vLLM/JetStream-style
+paging because HBM reservation, not compute, is what caps co-resident
+slots x context on a TPU chip (SURVEY.md section 7.2, hard part no. 1).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+SACRIFICIAL_PAGE = 0
+
+
+class PoolExhausted(RuntimeError):
+    """No free pages left to back a prefill/decode grow request."""
+
+    def __init__(self, needed: int, free: int):
+        super().__init__(
+            f"KV page pool exhausted: need {needed} page(s), {free} free"
+        )
+        self.needed = needed
+        self.free = free
+
+
+class PageAllocator:
+    """Free-list allocator over ``num_pages`` physical pages of ``page_size``
+    rows, mapping ``num_slots`` slots x ``max_blocks`` logical blocks."""
+
+    def __init__(self, num_pages: int, page_size: int, num_slots: int,
+                 max_blocks: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (one is sacrificial)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_slots = num_slots
+        self.max_blocks = max_blocks
+        # page 0 is the sacrificial page — never on the free list
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        # host copy of the device tables; unbacked entries map page 0
+        self.tables = np.full((num_slots, max_blocks), SACRIFICIAL_PAGE,
+                              dtype=np.int32)
+        self._blocks_used = np.zeros(num_slots, dtype=np.int64)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def blocks_for(self, rows: int) -> int:
+        return -(-rows // self.page_size)  # ceil
+
+    def ensure(self, slot: int, rows: int) -> bool:
+        """Back slot ``slot`` for ``rows`` logical rows; allocates any
+        missing pages. Returns True iff the table changed. Raises
+        PoolExhausted (leaving existing pages intact) if the free list
+        can't cover the growth."""
+        need = min(self.blocks_for(rows), self.max_blocks)
+        have = int(self._blocks_used[slot])
+        if need <= have:
+            return False
+        grow = need - have
+        if grow > len(self._free):
+            raise PoolExhausted(grow, len(self._free))
+        for b in range(have, need):
+            self.tables[slot, b] = self._free.pop()
+        self._blocks_used[slot] = need
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        """Return all of a slot's pages to the free list."""
+        used = int(self._blocks_used[slot])
+        for b in range(used):
+            self._free.append(int(self.tables[slot, b]))
+            self.tables[slot, b] = SACRIFICIAL_PAGE
+        self._blocks_used[slot] = 0
+
+    def slot_rows_backed(self, slot: int) -> int:
+        return int(self._blocks_used[slot]) * self.page_size

@@ -30,6 +30,11 @@ import jax
 
 from .decode_attention import decode_attention, decode_attention_reference
 from .flash_attention import flash_attention, flash_attention_reference
+from .paged_attention import (
+    gather_pages,
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
 from .quantized_matmul import (
     dequantize,
     quantize_int8,
@@ -42,6 +47,9 @@ __all__ = [
     "flash_attention_reference",
     "decode_attention",
     "decode_attention_reference",
+    "paged_decode_attention",
+    "paged_decode_attention_reference",
+    "gather_pages",
     "quantize_int8",
     "dequantize",
     "quantized_matmul",

@@ -1,0 +1,232 @@
+"""Paged ragged decode attention: the slot cache behind a page table.
+
+Same online-softmax recurrence as `decode_attention` (one query per slot
+against that slot's valid cache rows, double-buffered HBM→VMEM DMA), except
+K/V rows live in a shared *page pool* instead of one contiguous slab per
+slot: logical block i of slot b is physical page `tables[b, i]` of
+`[N, P, KH*D]`. The kernel reads the table from SMEM and DMAs only the
+pages that hold valid rows, so HBM is reserved per *page in use*, not per
+`num_slots x max_context` — that decoupling is what lets many long-context
+slots oversubscribe a fixed pool (SURVEY.md section 7.2 "paged KV cache in
+HBM"; the fixed-shape-jit half of hard part #1).
+
+The pool never moves: growth is a host-side free-list allocation plus a new
+table row passed with the next dispatch. Shapes stay static everywhere —
+the table is [B, MAX_BLOCKS] with garbage entries beyond each slot's
+length, never read because the loop bound comes from `lengths`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_decode_kernel(
+    len_ref,  # SMEM [B] int32
+    tbl_ref,  # SMEM [B, MB] int32 — logical block -> physical page
+    q_ref,  # VMEM [1, H, D]
+    k_pool,  # ANY  [N, P, KH*D]
+    v_pool,  # ANY  [N, P, KH*D]
+    o_ref,  # VMEM [1, H, D]
+    *,
+    num_kv_heads: int,
+    head_dim: int,
+    page_size: int,
+    window: Optional[int],
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    KH, D, P = num_kv_heads, head_dim, page_size
+    H = q_ref.shape[1]
+    G = H // KH
+
+    length = len_ref[b]  # row `length` holds the just-written token
+    total = length + 1
+    n_blk = pl.cdiv(total, P)
+    if window is not None:
+        start_blk = jnp.maximum(total - window, 0) // P
+    else:
+        start_blk = jnp.int32(0)
+
+    q = q_ref[0] * sm_scale  # [H, D]
+
+    def body(k_buf, v_buf, sems):
+        def dma(pool, scr, slot, blk, sem_idx):
+            # THE paged indirection: logical block -> physical page
+            return pltpu.make_async_copy(
+                pool.at[tbl_ref[b, blk]],
+                scr.at[slot],
+                sems.at[slot, sem_idx],
+            )
+
+        dma(k_pool, k_buf, 0, start_blk, 0).start()
+        dma(v_pool, v_buf, 0, start_blk, 1).start()
+
+        def loop(i, carry):
+            m, l, acc = carry  # [H, 1], [H, 1], [H, D] f32
+            slot = jax.lax.rem(i - start_blk, 2)
+
+            @pl.when(i + 1 < n_blk)
+            def _prefetch():
+                nxt = 1 - slot
+                dma(k_pool, k_buf, nxt, i + 1, 0).start()
+                dma(v_pool, v_buf, nxt, i + 1, 1).start()
+
+            dma(k_pool, k_buf, slot, i, 0).wait()
+            dma(v_pool, v_buf, slot, i, 1).wait()
+            kb = k_buf[slot]  # [P, KH*D]
+            vb = v_buf[slot]
+
+            cols = i * P + jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
+            valid = cols <= length
+            if window is not None:
+                valid = jnp.logical_and(valid, cols > length - window)
+
+            parts = []
+            for h in range(KH):
+                qh = q[h * G : (h + 1) * G, :]  # [G, D]
+                kh = kb[:, h * D : (h + 1) * D]  # [P, D]
+                parts.append(
+                    jax.lax.dot_general(
+                        qh,
+                        kh,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            s = jnp.concatenate(parts, axis=0)  # [H, P]
+            s = jnp.where(valid, s, NEG_INF)
+
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(s - m_new)
+            p = jnp.where(valid, p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+            outs = []
+            pv = p.astype(vb.dtype)
+            for h in range(KH):
+                ph = pv[h * G : (h + 1) * G, :]  # [G, P]
+                vh = vb[:, h * D : (h + 1) * D]  # [P, D]
+                outs.append(
+                    jax.lax.dot_general(
+                        ph,
+                        vh,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            acc_new = acc * alpha + jnp.concatenate(outs, axis=0)
+            return m_new, l_new, acc_new
+
+        init = (
+            jnp.full((H, 1), NEG_INF, jnp.float32),
+            jnp.zeros((H, 1), jnp.float32),
+            jnp.zeros((H, D), jnp.float32),
+        )
+        m, l, acc = jax.lax.fori_loop(start_blk, n_blk, loop, init)
+        safe_l = jnp.where(l <= 0.0, 1.0, l)
+        o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        k_buf=pltpu.VMEM((2, P, KH * D), k_pool.dtype),
+        v_buf=pltpu.VMEM((2, P, KH * D), v_pool.dtype),
+        sems=pltpu.SemaphoreType.DMA((2, 2)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, H, D] — one new query per slot
+    k_pool: jnp.ndarray,  # [N, P, KH, D] — shared page pool
+    v_pool: jnp.ndarray,  # [N, P, KH, D]
+    tables: jnp.ndarray,  # [B, MB] int32 — logical block -> physical page
+    lengths: jnp.ndarray,  # [B] int32; row `lengths[b]` is the newest token
+    *,
+    window: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Paged ragged decode attention; returns [B, H, D]."""
+    B, H, D = q.shape
+    N, P, KH = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        num_kv_heads=KH,
+        head_dim=D,
+        page_size=P,
+        window=window,
+        sm_scale=1.0 / float(np.sqrt(D)),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # page tables
+            pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        q,
+        k_pool.reshape(N, P, KH * D),
+        v_pool.reshape(N, P, KH * D),
+    )
+
+
+def gather_pages(pool: jnp.ndarray, table_row: jnp.ndarray) -> jnp.ndarray:
+    """Materialize one slot's logical cache view [MB*P, KH, D] from the
+    pool. Copies — used by the CPU reference path and by prefill-chunk
+    attention (compute-bound, so the copy is cheap there); the decode hot
+    path reads pages in place via the kernel."""
+    MB = table_row.shape[0]
+    P, KH, D = pool.shape[1], pool.shape[2], pool.shape[3]
+    return pool[table_row].reshape(MB * P, KH, D)
+
+
+def paged_decode_attention_reference(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Naive jnp paged decode attention (CPU fallback + parity truth):
+    gathers each slot's pages into a contiguous view, then does the same
+    masked attention as the dense reference."""
+    B, H, D = q.shape
+    KH = k_pool.shape[2]
+    G = H // KH
+    k = jax.vmap(lambda t: gather_pages(k_pool, t))(tables)  # [B, C, KH, D]
+    v = jax.vmap(lambda t: gather_pages(v_pool, t))(tables)
+    C = k.shape[1]
+    qg = q.reshape(B, KH, G, D)
+    s = jnp.einsum("bkgd,bckd->bkgc", qg, k).astype(jnp.float32)
+    s = s / np.sqrt(D)
+    cols = jnp.arange(C)[None, :]
+    mask = cols <= lengths[:, None]
+    if window is not None:
+        mask = mask & (cols > lengths[:, None] - window)
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgc,bckd->bkgd", p, v)
+    return out.reshape(B, H, D)

@@ -142,6 +142,23 @@ class ModelManager:
                 "using bf16",
                 kv_env,
             )
+        # AIOS_TPU_PAGED_KV=<rows> serves every model over a paged KV cache
+        # backed by a <rows>-row physical pool (engine/paged.py): slots x
+        # context becomes a logical limit, HBM is spent per page in use
+        self.paged_pool_rows: Optional[int] = None
+        paged_env = os.environ.get("AIOS_TPU_PAGED_KV", "")
+        if paged_env:
+            try:
+                rows = int(paged_env)
+            except ValueError:
+                rows = 0
+            if rows > 0 and sharding_plan is None:
+                self.paged_pool_rows = rows
+            else:
+                log.warning(
+                    "AIOS_TPU_PAGED_KV=%r ignored (need a positive row "
+                    "count and no sharding plan)", paged_env,
+                )
         # AIOS_TPU_SPECULATIVE=1 turns on n-gram speculative decode
         # dispatches (engine/spec.py): greedy agent requests — tool-call
         # JSON, quoted context — emit several tokens per verify round with
@@ -167,19 +184,53 @@ class ModelManager:
         t0 = time.time()
         try:
             cfg, params, tokenizer = self._load_weights(name, path, context_length)
+            cache_dtype = self.cache_dtype
+            if self.paged_pool_rows is not None and cache_dtype == jnp.int8:
+                log.warning(
+                    "AIOS_TPU_KV_CACHE=int8 ignored: paged KV cache is "
+                    "bf16-only for now"
+                )
+                cache_dtype = jnp.bfloat16
+            ctx = context_length or cfg.max_context
+            kw = {}
+            if self.paged_pool_rows is not None:
+                # page size must divide the context; 128 aligns with the
+                # kernel block and every power-of-two bucket >= 128. An
+                # indivisible context degrades to the dense cache (like
+                # every other invalid paged config) instead of failing load.
+                if ctx % 128 == 0:
+                    kw = dict(
+                        paged_pool_rows=self.paged_pool_rows, page_size=128
+                    )
+                elif ctx % 16 == 0:
+                    kw = dict(
+                        paged_pool_rows=self.paged_pool_rows, page_size=16
+                    )
+                else:
+                    log.warning(
+                        "AIOS_TPU_PAGED_KV ignored for %s: context %d is "
+                        "not a multiple of 16; serving dense", name, ctx,
+                    )
             engine = TPUEngine(
                 cfg,
                 params,
                 num_slots=self.num_slots,
-                max_context=context_length or cfg.max_context,
+                max_context=ctx,
                 shardings=self.plan,
                 quantize=self.quantize,
-                cache_dtype=self.cache_dtype,
+                cache_dtype=cache_dtype,
+                **kw,
             )
             del params
             if self.warm_compile:
                 engine.warmup()
-            batcher = ContinuousBatcher(engine, speculative=self.speculative)
+            speculative = self.speculative and not engine.paged
+            if self.speculative and engine.paged:
+                log.warning(
+                    "AIOS_TPU_SPECULATIVE=1 ignored: speculative decoding "
+                    "is dense-only for now (paged KV enabled)"
+                )
+            batcher = ContinuousBatcher(engine, speculative=speculative)
             managed = ManagedModel(
                 name=name,
                 config=cfg,

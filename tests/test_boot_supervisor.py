@@ -32,6 +32,11 @@ def _build_supervisor(tmp_path, max_restarts=5):
              for name in ("runtime", "memory", "tools", "gateway", "orchestrator")}
     shared_env = {
         "JAX_PLATFORMS": "cpu",
+        # this image's TPU-tunnel site hook registers its PJRT plugin in
+        # every python process when this var is set, and a wedged tunnel
+        # then hangs even JAX_PLATFORMS=cpu children at import — the boot
+        # e2e is CPU-only, so disable the hook for the service children
+        "PALLAS_AXON_POOL_IPS": "",
         "AIOS_DATA_DIR": str(tmp_path / "data"),
         "AIOS_AUDIT_DB": str(tmp_path / "audit.db"),
         "AIOS_MODEL_DIR": str(tmp_path / "no-models"),  # autoload no-op

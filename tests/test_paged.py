@@ -1,0 +1,254 @@
+"""Paged KV cache: allocator, paged attention parity, engine equivalence.
+
+The paged cache must be OBSERVABLY identical to the dense slot cache —
+same tokens, same masks — while reserving HBM per page in use instead of
+per num_slots x max_context (SURVEY.md section 7.2, hard part no. 1's
+fixed-shape half). Kernel parity runs under the Pallas interpreter on CPU,
+like the other kernels (tests/test_ops.py pattern).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu.engine import model
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.engine.paged import PageAllocator, PoolExhausted
+from aios_tpu.ops import (
+    decode_attention_reference,
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model.init_params(TINY_TEST, jax.random.PRNGKey(1), dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_ensure_and_free():
+    a = PageAllocator(num_pages=9, page_size=16, num_slots=2, max_blocks=8)
+    assert a.free_pages == 8  # page 0 is sacrificial
+    assert a.ensure(0, 17) is True  # 2 blocks
+    assert a.ensure(0, 17) is False  # idempotent
+    assert a.pages_in_use() == 2
+    assert a.slot_rows_backed(0) == 32
+    assert (a.tables[0, :2] > 0).all()
+    assert (a.tables[0, 2:] == 0).all()
+    a.free_slot(0)
+    assert a.pages_in_use() == 0
+    assert (a.tables[0] == 0).all()
+
+
+def test_allocator_exhaustion_keeps_state():
+    a = PageAllocator(num_pages=4, page_size=16, num_slots=2, max_blocks=8)
+    a.ensure(0, 32)  # 2 of 3 free pages
+    with pytest.raises(PoolExhausted):
+        a.ensure(1, 33)  # needs 3, only 1 free
+    assert a.free_pages == 1
+    assert a.slot_rows_backed(1) == 0
+    a.free_slot(0)
+    assert a.ensure(1, 33) is True  # now it fits
+
+
+def test_allocator_pages_are_exclusive():
+    a = PageAllocator(num_pages=9, page_size=16, num_slots=4, max_blocks=2)
+    for s in range(4):
+        a.ensure(s, 32)
+    pages = a.tables[:, :2].ravel().tolist()
+    assert len(set(pages)) == 8  # no page handed to two slots
+    assert 0 not in pages
+
+
+# ---------------------------------------------------------------------------
+# paged attention parity
+# ---------------------------------------------------------------------------
+
+
+def _scattered_equivalent(rng, B, C, KH, D, P, dtype=jnp.float32):
+    """Dense [B, C, KH, D] caches and a paged pool holding the same rows
+    behind a shuffled page table."""
+    MB = C // P
+    dense = jnp.asarray(rng.normal(size=(B, C, KH, D)), dtype)
+    # physical pages shuffled: logical block b of slot s -> some unique page
+    perm = rng.permutation(B * MB)
+    tables = jnp.asarray(1 + perm.reshape(B, MB), jnp.int32)
+    pool = jnp.zeros((1 + B * MB, P, KH, D), dtype)
+    for s in range(B):
+        for b in range(MB):
+            pool = pool.at[int(tables[s, b])].set(
+                dense[s, b * P : (b + 1) * P]
+            )
+    return dense, pool, tables
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_paged_reference_matches_dense_reference(window):
+    rng = np.random.default_rng(0)
+    B, C, KH, D, H, P = 3, 64, 2, 8, 4, 16
+    kd, kp, tables = _scattered_equivalent(rng, B, C, KH, D, P)
+    vd, vp, _ = _scattered_equivalent(rng, B, C, KH, D, P)
+    # v pool must use the same tables as k: rebuild it under k's tables
+    vp = jnp.zeros_like(kp)
+    for s in range(B):
+        for b in range(C // P):
+            vp = vp.at[int(tables[s, b])].set(vd[s, b * P : (b + 1) * P])
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    lengths = jnp.asarray([5, 31, 63], jnp.int32)
+    ref = decode_attention_reference(q, kd, vd, lengths, window=window)
+    got = paged_decode_attention_reference(
+        q, kp, vp, tables, lengths, window=window
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_paged_kernel_matches_reference(window):
+    rng = np.random.default_rng(1)
+    B, C, KH, D, H, P = 2, 64, 2, 8, 4, 16
+    kd, kp, tables = _scattered_equivalent(rng, B, C, KH, D, P)
+    vd, vp0, _ = _scattered_equivalent(rng, B, C, KH, D, P)
+    vp = jnp.zeros_like(kp)
+    for s in range(B):
+        for b in range(C // P):
+            vp = vp.at[int(tables[s, b])].set(vd[s, b * P : (b + 1) * P])
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    lengths = jnp.asarray([9, 50], jnp.int32)
+    ref = paged_decode_attention_reference(
+        q, kp, vp, tables, lengths, window=window
+    )
+    got = paged_decode_attention(
+        q, kp, vp, tables, lengths, window=window, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_paged_kernel_ignores_unmapped_pages():
+    """Rows beyond a slot's length live on pages the table never maps —
+    poisoning every unmapped pool page must not change the output."""
+    rng = np.random.default_rng(2)
+    B, C, KH, D, H, P = 1, 64, 2, 8, 4, 16
+    kd, kp, tables = _scattered_equivalent(rng, B, C, KH, D, P)
+    vd, _, _ = _scattered_equivalent(rng, B, C, KH, D, P)
+    vp = jnp.zeros_like(kp)
+    for b in range(C // P):
+        vp = vp.at[int(tables[0, b])].set(vd[0, b * P : (b + 1) * P])
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    lengths = jnp.asarray([20], jnp.int32)  # blocks 0-1 valid; 2-3 unread
+    base = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    # poison the pages holding blocks 2..3 AND the sacrificial page
+    for pg in (0, int(tables[0, 2]), int(tables[0, 3])):
+        kp = kp.at[pg].set(1e9)
+        vp = vp.at[pg].set(1e9)
+    got = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base), rtol=1e-6,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine equivalence
+# ---------------------------------------------------------------------------
+
+
+def make_dense(params, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_context", 256)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return TPUEngine(TINY_TEST, params, **kw)
+
+
+def make_paged(params, pool_rows=4 * 256, page_size=32, **kw):
+    return make_dense(
+        params, paged_pool_rows=pool_rows, page_size=page_size, **kw
+    )
+
+
+def test_paged_generate_matches_dense(params):
+    prompt = [1, 2, 3, 4, 5]
+    dense = make_dense(params)
+    ref = dense.generate(prompt, max_new_tokens=48, temperature=0.0)
+    dense.close()
+    pg = make_paged(params)
+    got = pg.generate(prompt, max_new_tokens=48, temperature=0.0)
+    pg.close()
+    assert got == ref
+
+
+def test_paged_batched_slots_match_dense(params):
+    prompts = {0: [1, 2, 3], 1: list(range(7, 47)), 3: [9, 8, 7, 6]}
+    outs = {}
+    for paged in (False, True):
+        eng = make_paged(params) if paged else make_dense(params)
+        for s, p in prompts.items():
+            eng.prefill(s, p, temperature=0.0)
+        toks = eng.step(12)  # [12, S]
+        outs[paged] = {s: toks[:, s].tolist() for s in prompts}
+        eng.close()
+    assert outs[True] == outs[False]
+
+
+def test_paged_oversubscription_and_reuse(params):
+    """Logical capacity (4 slots x 256) is 4x the physical pool; short
+    requests run fine and released pages recycle."""
+    eng = make_paged(params, pool_rows=256, page_size=32)
+    for round_ in range(3):
+        for s in range(4):
+            eng.prefill(s, [1 + s, 2, 3], temperature=0.0)
+        eng.step(4)
+        for s in range(4):
+            eng.release(s)
+        assert eng.allocator.pages_in_use() == 0
+    eng.close()
+
+
+def test_paged_pool_exhaustion_raises(params):
+    eng = make_paged(params, pool_rows=64, page_size=32)  # 2 usable pages
+    eng.prefill(0, [1] * 30, temperature=0.0)  # 1 page
+    eng.prefill(1, [2] * 30, temperature=0.0)  # 1 page
+    with pytest.raises(PoolExhausted):
+        eng.step(8)  # slot 0 needs rows 30..37 -> a third page
+    eng.close()
+
+
+def test_batcher_evicts_longest_on_exhaustion(params):
+    eng = make_paged(params, pool_rows=96, page_size=32, num_slots=3)
+    b = ContinuousBatcher(eng)
+    hs = [
+        b.submit(Request(prompt_ids=[s + 1, 2, 3], max_tokens=80,
+                         temperature=0.0))
+        for s in range(3)
+    ]
+    outs = [h.tokens() for h in hs]
+    b.shutdown()
+    assert b.last_error is None
+    assert b.pool_evictions >= 1  # someone was retired early
+    assert all(len(o) > 0 for o in outs)
+    assert any(len(o) == 80 for o in outs)  # and someone ran to completion
+    assert eng.allocator.pages_in_use() == 0
+    eng.close()
+
+
+def test_batcher_fails_only_oversized_prompt(params):
+    eng = make_paged(params, pool_rows=64, page_size=32, num_slots=2)
+    b = ContinuousBatcher(eng)
+    big = b.submit(Request(prompt_ids=[1] * 120, max_tokens=4,
+                           temperature=0.0))  # needs 4 pages, pool has 2
+    small = b.submit(Request(prompt_ids=[1, 2, 3], max_tokens=8,
+                             temperature=0.0))
+    big_out = big.tokens()
+    small_out = small.tokens()
+    b.shutdown()
+    assert b.last_error is None
+    assert big_out == []  # failed cleanly, iterator ended
+    assert len(small_out) == 8  # unaffected
+    eng.close()
