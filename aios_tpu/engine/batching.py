@@ -115,7 +115,6 @@ class ContinuousBatcher:
         if self.prefill_chunk is not None and (
             self.prefill_chunk not in engine.buckets
             or engine.max_context % self.prefill_chunk
-            or engine.paged  # chunked admission unsupported on paged v1
         ):
             self.prefill_chunk = None
         # paged engines can run out of physical KV pages mid-stream; the
@@ -194,7 +193,23 @@ class ContinuousBatcher:
         if self._prefilling is None:
             return
         live, pc = self._prefilling
-        first = pc.step()
+        while True:
+            try:
+                first = pc.step()
+                break
+            except PoolExhausted:
+                # mid-admission exhaustion: free pages and retry the SAME
+                # chunk NOW — deferring to the next tick would let _admit()
+                # hand the freed pages to a new request and force another
+                # eviction. With nobody left to evict the admission itself
+                # is the victim (its partial pages release).
+                if not self._evict_longest():
+                    self._prefilling = None
+                    self._reserved_slot = -1
+                    live.done = True
+                    self.engine.release(live.slot)
+                    live.out_q.put(_END)
+                    return
         if first is not None:
             self._prefilling = None
             self._reserved_slot = -1

@@ -131,6 +131,10 @@ class TPUEngine:
                 raise ValueError("paged KV cache is single-chip for now")
             if self.quant_cache:
                 raise ValueError("paged KV cache requires a bf16/f32 cache")
+            if page_size < 1 or page_size & (page_size - 1):
+                # chunked admission relies on power-of-two chunk/page sizes
+                # never straddling (model.prefill_chunk_paged)
+                raise ValueError(f"page_size {page_size} must be a power of 2")
             if self.max_context % page_size:
                 raise ValueError(
                     f"max_context {self.max_context} must be a multiple of "
@@ -431,18 +435,40 @@ class TPUEngine:
             out["v_s"] = v_s
         return out, first
 
-    def _prefill_chunk_impl(self, params, state: DecodeState, tokens, slot, start):
-        """Mid-prompt chunk: write K/V rows [start, start+Tc), no sampling."""
-        scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
-        out = model.prefill_chunk(
-            params, self.cfg, tokens, slot, start, state["k"], state["v"],
-            cache_scales=scales,
-        )
-        new = dict(state)
-        if self.quant_cache:
-            _, new["k"], new["v"], (new["k_s"], new["v_s"]) = out
+    def _chunk_forward(self, params, state: DecodeState, tokens, slot, start,
+                       table_row):
+        """One prefill chunk against whichever cache layout this engine
+        runs (paged / int8 KV / dense); returns (logits, kv-state updates).
+        The single place the layout dispatch lives — both chunk impls
+        build on it."""
+        upd: Dict[str, jnp.ndarray] = {}
+        if self.paged:
+            logits, upd["k"], upd["v"] = model.prefill_chunk_paged(
+                params, self.cfg, tokens, start, state["k"], state["v"],
+                table_row,
+            )
         else:
-            _, new["k"], new["v"] = out
+            scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
+            out = model.prefill_chunk(
+                params, self.cfg, tokens, slot, start, state["k"], state["v"],
+                cache_scales=scales,
+            )
+            if self.quant_cache:
+                logits, upd["k"], upd["v"], (upd["k_s"], upd["v_s"]) = out
+            else:
+                logits, upd["k"], upd["v"] = out
+        return logits, upd
+
+    def _prefill_chunk_impl(
+        self, params, state: DecodeState, tokens, slot, start, table_row=None
+    ):
+        """Mid-prompt chunk: write K/V rows [start, start+Tc), no sampling.
+        Paged engines route the writes through ``table_row`` (the slot's
+        block->page map) instead of the slot index."""
+        _, upd = self._chunk_forward(params, state, tokens, slot, start,
+                                     table_row)
+        new = dict(state)
+        new.update(upd)
         new["history"] = jax.lax.dynamic_update_slice(
             state["history"], tokens, (slot, start)
         )
@@ -450,20 +476,14 @@ class TPUEngine:
 
     def _final_chunk_impl(
         self, params, state: DecodeState, tokens, slot, start, n_valid,
-        true_len, temp, top_p,
+        true_len, temp, top_p, table_row=None,
     ):
         """Last chunk: write K/V, then sample the first token from the
         logits row of the prompt's true last token and activate the slot."""
-        scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
-        out = model.prefill_chunk(
-            params, self.cfg, tokens, slot, start, state["k"], state["v"],
-            cache_scales=scales,
-        )
+        logits, upd = self._chunk_forward(params, state, tokens, slot, start,
+                                          table_row)
         new = dict(state)
-        if self.quant_cache:
-            logits, new["k"], new["v"], (new["k_s"], new["v_s"]) = out
-        else:
-            logits, new["k"], new["v"] = out
+        new.update(upd)
         key, sub = jax.random.split(state["key"])
         last = logits[0, n_valid - 1][None, :]  # [1, V]
         first = sampling.sample(last, sub, temp[None], top_p[None])[0]
@@ -587,11 +607,6 @@ class TPUEngine:
         max_context so chunk writes never spill past the cache end."""
         if not 0 <= slot < self.num_slots:
             raise ValueError(f"slot {slot} out of range")
-        if self.paged:
-            raise ValueError(
-                "chunked prefill is not supported on a paged engine yet; "
-                "admit monolithically (batching.py auto-disables chunking)"
-            )
         if chunk not in self.buckets or self.max_context % chunk:
             raise ValueError(
                 f"chunk {chunk} must be a prefill bucket dividing "
@@ -738,8 +753,6 @@ class TPUEngine:
             # first real prompt then eats the compile mid-serving)
             self.prefill(0, [1] * (bucket // 2 + 1), temperature=0.0)
             self.release(0)
-        if self.paged:
-            prefill_chunk = 0  # chunked admission unsupported on paged v1
         ck = self.prefill_chunk_default if prefill_chunk is None else prefill_chunk
         if not ck:
             ck = None
@@ -749,6 +762,10 @@ class TPUEngine:
                     break
                 # remainder in (b/2, b] so bucket_for(remainder) == b
                 n = min(ck + b // 2 + 1, self.max_context - 1)
+                if self.paged and self.allocator.blocks_for(
+                    n
+                ) > self.allocator.free_pages:
+                    continue
                 pc = self.start_chunked_prefill(0, [1] * n, chunk=ck)
                 while pc.step() is None:
                     pass
@@ -870,6 +887,12 @@ class ChunkedPrefill:
         padded = np.zeros((1, bucket), dtype=np.int32)
         padded[0, :n] = self.ids[self.pos : self.pos + n]
         with eng._lock:
+            extra = ()
+            if eng.paged:
+                # back this chunk's rows before dispatching; PoolExhausted
+                # surfaces to the batcher with all state untouched
+                eng.allocator.ensure(self.slot, self.pos + n)
+                extra = (jnp.asarray(eng.allocator.tables[self.slot]),)
             if final:
                 eng.state, first = eng._chunk_fn(bucket, True)(
                     eng.params,
@@ -881,6 +904,7 @@ class ChunkedPrefill:
                     jnp.int32(len(self.ids)),
                     jnp.float32(self.temperature),
                     jnp.float32(self.top_p),
+                    *extra,
                 )
                 eng.active[self.slot] = True
                 eng._host_lengths[self.slot] = len(self.ids)
@@ -892,6 +916,7 @@ class ChunkedPrefill:
                     jnp.asarray(padded),
                     jnp.int32(self.slot),
                     jnp.int32(self.pos),
+                    *extra,
                 )
         self.pos += n
         return self.first_token

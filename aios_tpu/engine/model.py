@@ -651,6 +651,78 @@ def decode_step(
     return logits, k_cache, v_cache
 
 
+def prefill_chunk_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [1, Tc] int32 — one chunk of one prompt
+    start: jnp.ndarray,  # scalar int32 — absolute position of tokens[0]
+    k_pool: jnp.ndarray,  # [L, N, P, KH, D]
+    v_pool: jnp.ndarray,  # [L, N, P, KH, D]
+    table_row: jnp.ndarray,  # [MB] int32 — the slot's block->page map
+):
+    """One chunk of an incremental prefill against the PAGED cache.
+
+    Same contract as ``prefill_chunk`` (write rows [start, start+Tc) of the
+    slot, attend each chunk token over everything written so far), with the
+    rows scattered into the page pool through ``table_row``. Because chunk
+    sizes and page sizes are both powers of two, a chunk either spans whole
+    pages (Tc >= P, start page-aligned) or sits inside one page (Tc < P) —
+    the write indices are static repeats, never an index-array gather.
+    Chunk attention gathers the slot's logical view from the pool per layer
+    (a copy, but prefill is compute-bound; the decode hot path reads pages
+    in place via the kernel). The caller must have backed rows
+    [0, start+Tc) — unbacked blocks map the sacrificial page 0, which the
+    mask never exposes below ``start+Tc``.
+
+    Returns (logits [1, Tc, V] fp32, k_pool', v_pool').
+    """
+    B, Tc = tokens.shape
+    MB = table_row.shape[0]
+    P = k_pool.shape[2]
+    C_log = MB * P
+    x = params["embed"][tokens]  # [1, Tc, E]
+    positions = start + jnp.arange(Tc)[None, :]  # [1, Tc]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    if Tc >= P:  # page-aligned chunk spanning Tc/P whole pages
+        nb = Tc // P
+        pages_blk = jax.lax.dynamic_slice(table_row, (start // P,), (nb,))
+        pages = jnp.repeat(pages_blk, P)  # [Tc]
+        offs = jnp.arange(Tc) % P
+    else:  # chunk inside one page
+        page = jax.lax.dynamic_slice(table_row, (start // P,), (1,))[0]
+        pages = jnp.broadcast_to(page, (Tc,))
+        offs = (start % P) + jnp.arange(Tc)
+
+    t = min(512, C_log)
+    kv_tile = t if C_log % t == 0 else P
+
+    def block(x, layer):
+        lp, k_l, v_l = layer
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        k_l = k_l.at[pages, offs].set(k_new[0].astype(k_l.dtype))
+        v_l = v_l.at[pages, offs].set(v_new[0].astype(v_l.dtype))
+        k_all = k_l[table_row].reshape(1, C_log, *k_l.shape[2:])
+        v_all = v_l[table_row].reshape(1, C_log, *v_l.shape[2:])
+        attn = blockwise_cache_attention(
+            q,
+            k_all.astype(q.dtype),
+            v_all.astype(q.dtype),
+            positions[0],
+            cfg.sliding_window,
+            kv_tile,
+        )
+        x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"])
+        x = x + _mlp(x, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        block, x, (params["layers"], k_pool, v_pool)
+    )
+    logits = _final_logits(x, params, cfg)
+    return logits, k_pool, v_pool
+
+
 def decode_step_paged(
     params: Params,
     cfg: ModelConfig,
