@@ -58,6 +58,7 @@ class TPUEngine:
         sharded_attention: Optional[bool] = None,  # shard_map ragged decode
         paged_pool_rows: Optional[int] = None,  # physical KV rows -> paged
         page_size: int = 128,
+        prefix_cache: Optional[bool] = None,  # None -> on when paged
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -126,6 +127,8 @@ class TPUEngine:
         # engine/paged.py (tables) + ops/paged_attention.py (reads).
         self.paged = paged_pool_rows is not None
         self.allocator: Optional[paged.PageAllocator] = None
+        self.prefix_index: Optional[paged.PrefixIndex] = None
+        self._prefix_chunk: Optional[int] = None
         if self.paged:
             if shardings is not None:
                 raise ValueError("paged KV cache is single-chip for now")
@@ -150,6 +153,24 @@ class TPUEngine:
                 cfg.num_kv_heads, cfg.head_dim,
             )
             k, v = jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype)
+            # Prefix caching rides on the page pool: prompts whose leading
+            # full blocks hash-match an earlier prompt map those pages
+            # instead of recomputing them (paged.PrefixIndex). The tail
+            # (always >= 1 token) admits through the chunked path, which
+            # attends over the mapped prefix for free. Matching needs a
+            # chunk size the bucket grid can honour.
+            self._prefix_chunk = max(
+                (b for b in self.buckets
+                 if b <= self.prefill_chunk_default
+                 and self.max_context % b == 0),
+                default=None,
+            )
+            if prefix_cache is None:
+                prefix_cache = True
+            if prefix_cache and self._prefix_chunk is not None:
+                self.prefix_index = paged.PrefixIndex(
+                    self.allocator, max_pages=num_pages
+                )
         else:
             k, v = model.init_kv_cache(
                 cfg, num_slots, self.max_context, cache_dtype
@@ -190,6 +211,7 @@ class TPUEngine:
         self._chunk_fns: Dict[Tuple[int, bool], object] = {}
         self._spec_fns: Dict[Tuple[int, int, int], object] = {}
         self.decode_steps = 0
+        self.prefix_rows_reused = 0
 
     # -- jitted cores -------------------------------------------------------
 
@@ -469,10 +491,19 @@ class TPUEngine:
                                      table_row)
         new = dict(state)
         new.update(upd)
-        new["history"] = jax.lax.dynamic_update_slice(
-            state["history"], tokens, (slot, start)
-        )
+        new["history"] = self._chunk_history(state, tokens, slot, start)
         return new
+
+    @staticmethod
+    def _chunk_history(state, tokens, slot, start):
+        """Write a chunk's tokens at history cols [start, start+bucket),
+        clamping overflow cols onto the sacrificial last pad column — a
+        prefix match de-aligns chunk starts, so a final bucket's padding
+        may overrun the buffer (dynamic_update_slice would clamp the START
+        and silently shift real tokens)."""
+        W = state["history"].shape[1]
+        hcol = jnp.clip(start + jnp.arange(tokens.shape[1]), 0, W - 1)
+        return state["history"].at[slot, hcol].set(tokens[0])
 
     def _final_chunk_impl(
         self, params, state: DecodeState, tokens, slot, start, n_valid,
@@ -487,9 +518,7 @@ class TPUEngine:
         key, sub = jax.random.split(state["key"])
         last = logits[0, n_valid - 1][None, :]  # [1, V]
         first = sampling.sample(last, sub, temp[None], top_p[None])[0]
-        history = jax.lax.dynamic_update_slice(
-            state["history"], tokens, (slot, start)
-        )
+        history = self._chunk_history(state, tokens, slot, start)
         new["lengths"] = state["lengths"].at[slot].set(true_len)
         new["last_tokens"] = state["last_tokens"].at[slot].set(first)
         new["temps"] = state["temps"].at[slot].set(temp)
@@ -542,6 +571,75 @@ class TPUEngine:
             self._chunk_fns[key] = fn
         return fn
 
+    def _hist_fn(self, bucket: int):
+        key = ("hist", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            def impl(state, tokens, slot, start):
+                new = dict(state)
+                new["history"] = jax.lax.dynamic_update_slice(
+                    state["history"], tokens, (slot, start)
+                )
+                return new
+
+            fn = jax.jit(impl, donate_argnums=(0,))
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _write_history(self, slot: int, ids: List[int], start: int = 0) -> None:
+        """Backfill history cols [start, start+len(ids)) in bucket-sized
+        dispatches (a matched prefix can exceed the largest bucket)."""
+        pos = 0
+        while pos < len(ids):
+            seg = ids[pos : pos + self.buckets[-1]]
+            bucket = self.bucket_for(len(seg))
+            padded = np.zeros((1, bucket), dtype=np.int32)
+            padded[0, : len(seg)] = seg
+            self.state = self._hist_fn(bucket)(
+                self.state, jnp.asarray(padded), jnp.int32(slot),
+                jnp.int32(start + pos),
+            )
+            pos += len(seg)
+
+    # -- prefix caching (paged engines; paged.PrefixIndex) ------------------
+
+    def _match_prefix(self, slot: int, ids: List[int]):
+        """Map the longest hash-matched prompt prefix into ``slot``'s page
+        table (shared, read-only) and backfill its token history. Returns
+        (matched_rows, block_hashes). Caller holds the engine lock.
+
+        matched_rows is page-aligned but NOT chunk-aligned — the tail's
+        chunk starts inherit the misalignment, which the chunk writers are
+        built for (prefill_chunk_paged's sacrificial-page slice padding,
+        _chunk_history's clamped scatter)."""
+        if self.prefix_index is None:
+            return 0, []
+        P = self.allocator.page_size
+        full = (len(ids) - 1) // P  # cap: at least one tail row remains
+        if full <= 0:
+            return 0, []
+        hashes = paged.chain_hashes(ids, P, full)
+        pages = self.prefix_index.match(hashes)
+        if not pages:
+            return 0, hashes
+        self.allocator.map_shared(slot, pages)
+        matched = len(pages) * P
+        self.prefix_rows_reused += matched
+        # the n-gram proposer reads history[0:length] — backfill the
+        # shared region (padding past `matched` inside the last segment's
+        # bucket is overwritten by the tail chunks writing [matched, len))
+        self._write_history(slot, ids[:matched])
+        return matched, hashes
+
+    def _register_prefix(self, slot: int, ids: List[int], hashes) -> None:
+        """After a successful admission, publish the slot's fully-covered
+        prompt blocks to the index so the NEXT prompt with this prefix
+        skips their prefill. Caller holds the engine lock."""
+        if self.prefix_index is None or not hashes:
+            return
+        pages = [int(self.allocator.tables[slot, b]) for b in range(len(hashes))]
+        self.prefix_index.put(hashes, pages)
+
     # -- public API ---------------------------------------------------------
 
     def bucket_for(self, length: int) -> int:
@@ -567,6 +665,28 @@ class TPUEngine:
         true_len = len(token_ids)
         if true_len == 0:
             raise ValueError("empty prompt")
+
+        matched, hashes = 0, []
+        if self.prefix_index is not None:
+            with self._lock:
+                matched, hashes = self._match_prefix(slot, token_ids)
+        if matched:
+            # tail-only admission through the chunked path, which attends
+            # over the mapped prefix; release on failure so the shared
+            # pages don't leak into the batcher's retry
+            pc = ChunkedPrefill(
+                self, slot, token_ids, temperature, top_p,
+                self._prefix_chunk, start_pos=matched, hashes=hashes,
+            )
+            try:
+                first = pc.step()
+                while first is None:
+                    first = pc.step()
+            except BaseException:
+                self.release(slot)
+                raise
+            return first
+
         bucket = self.bucket_for(true_len)
         padded = np.zeros((1, bucket), dtype=np.int32)
         padded[0, :true_len] = token_ids
@@ -590,6 +710,7 @@ class TPUEngine:
             self.state, first = self._prefill_fn(bucket)(*args)
             self.active[slot] = True
             self._host_lengths[slot] = true_len
+            self._register_prefix(slot, token_ids, hashes)
             return int(first)
 
     def start_chunked_prefill(
@@ -612,7 +733,15 @@ class TPUEngine:
                 f"chunk {chunk} must be a prefill bucket dividing "
                 f"max_context={self.max_context}"
             )
-        return ChunkedPrefill(self, slot, token_ids, temperature, top_p, chunk)
+        ids = list(token_ids)[-(self.max_context - 1) :]
+        matched, hashes = 0, []
+        if self.prefix_index is not None:
+            with self._lock:
+                matched, hashes = self._match_prefix(slot, ids)
+        return ChunkedPrefill(
+            self, slot, ids, temperature, top_p, chunk,
+            start_pos=matched, hashes=hashes,
+        )
 
     def step(self, n_steps: int = 1) -> np.ndarray:
         """Run ``n_steps`` batched decode steps in one dispatch.
@@ -740,7 +869,47 @@ class TPUEngine:
         readiness gate doesn't stall active decode on an XLA compile inside
         the scheduler thread. Pass the batcher's chunk size if it overrides
         the shared default, or 0 to skip.
+
+        Prefix matching is suspended for the duration: warmup's synthetic
+        prompts must compile every monolithic prefill bucket, and a
+        self-match would short-circuit the larger buckets onto the chunked
+        path (and pollute the index with junk blocks).
         """
+        prefix_index, self.prefix_index = self.prefix_index, None
+        try:
+            self._warmup_graphs(step_sizes, prefill_chunk)
+        finally:
+            self.prefix_index = prefix_index
+        if self.prefix_index is not None:
+            self._warmup_prefix_graphs()
+
+    def _warmup_prefix_graphs(self) -> None:
+        """Compile everything a prefix HIT can dispatch — the
+        history-backfill graphs and the tail's chunk graphs — so the first
+        resubmitted agent preamble after the readiness gate doesn't stall
+        live requests on an XLA compile (the TTFT-stall class the warmup
+        bucket fix addressed for cold prompts)."""
+        for b in self.buckets:
+            self._write_history(0, [0] * (b // 2 + 1))
+        pc = self._prefix_chunk
+        # Drive real admissions: the first registers its blocks, each later
+        # one matches `pc` rows and its tail lands in a distinct final
+        # bucket; the last forces one mid chunk too. Cheap when the normal
+        # chunk warmup already compiled these graphs; essential when the
+        # batcher's chunk size and the prefix chunk size diverge.
+        tails = [b // 2 + 1 for b in self.buckets if b <= pc]
+        tails.append(pc + 17)
+        for tail in tails:
+            n = pc + tail
+            if n > self.max_context - 1:
+                continue
+            if self.allocator.blocks_for(n) > self.allocator.num_pages - 1:
+                continue  # pool too small for this prompt either way
+            self.prefill(0, [7] * n, temperature=0.0)
+            self.release(0)
+        self.prefix_index.clear()  # drop the synthetic warmup blocks
+
+    def _warmup_graphs(self, step_sizes, prefill_chunk) -> None:
         for bucket in self.buckets:
             if self.paged and self.allocator.blocks_for(
                 bucket // 2 + 1
@@ -857,6 +1026,8 @@ class ChunkedPrefill:
         temperature: float,
         top_p: float,
         chunk: int,
+        start_pos: int = 0,  # rows already in the cache (matched prefix)
+        hashes=(),  # block hashes to publish to the prefix index when done
     ) -> None:
         ids = list(token_ids)[-(engine.max_context - 1) :]
         if not ids:
@@ -867,7 +1038,8 @@ class ChunkedPrefill:
         self.temperature = float(temperature)
         self.top_p = float(top_p)
         self.chunk = int(chunk)
-        self.pos = 0
+        self.pos = int(start_pos)
+        self.hashes = hashes
         self.first_token: Optional[int] = None
 
     @property
@@ -908,6 +1080,7 @@ class ChunkedPrefill:
                 )
                 eng.active[self.slot] = True
                 eng._host_lengths[self.slot] = len(self.ids)
+                eng._register_prefix(self.slot, self.ids, self.hashes)
                 self.first_token = int(first)
             else:
                 eng.state = eng._chunk_fn(bucket, False)(

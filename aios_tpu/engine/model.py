@@ -686,7 +686,15 @@ def prefill_chunk_paged(
 
     if Tc >= P:  # page-aligned chunk spanning Tc/P whole pages
         nb = Tc // P
-        pages_blk = jax.lax.dynamic_slice(table_row, (start // P,), (nb,))
+        # pad with sacrificial entries so a final bucket whose padding
+        # overruns max_context (possible when a prefix match de-aligns
+        # chunk starts) slices cleanly: overflow rows land on page 0
+        # instead of dynamic_slice clamping the start a block early and
+        # corrupting the previous chunk's rows
+        table_ext = jnp.concatenate(
+            [table_row, jnp.zeros((nb,), table_row.dtype)]
+        )
+        pages_blk = jax.lax.dynamic_slice(table_ext, (start // P,), (nb,))
         pages = jnp.repeat(pages_blk, P)  # [Tc]
         offs = jnp.arange(Tc) % P
     else:  # chunk inside one page

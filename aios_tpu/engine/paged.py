@@ -21,7 +21,8 @@ slots x context on a TPU chip (SURVEY.md section 7.2, hard part no. 1).
 
 from __future__ import annotations
 
-from typing import List
+from collections import OrderedDict
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -57,6 +58,12 @@ class PageAllocator:
         self.tables = np.full((num_slots, max_blocks), SACRIFICIAL_PAGE,
                               dtype=np.int32)
         self._blocks_used = np.zeros(num_slots, dtype=np.int64)
+        # pages mapped by more than one owner (prefix sharing) carry a
+        # refcount; rc 0 means free
+        self._rc = np.zeros(num_pages, dtype=np.int64)
+        # called with the shortfall when the free list runs dry; returns
+        # how many pages it reclaimed (PrefixIndex.reclaim plugs in here)
+        self.reclaimer: Optional[Callable[[int], int]] = None
 
     @property
     def free_pages(self) -> int:
@@ -68,30 +75,158 @@ class PageAllocator:
     def blocks_for(self, rows: int) -> int:
         return -(-rows // self.page_size)  # ceil
 
+    def _take(self, grow: int) -> None:
+        if grow > len(self._free) and self.reclaimer is not None:
+            self.reclaimer(grow - len(self._free))
+        if grow > len(self._free):
+            raise PoolExhausted(grow, len(self._free))
+
     def ensure(self, slot: int, rows: int) -> bool:
         """Back slot ``slot`` for ``rows`` logical rows; allocates any
-        missing pages. Returns True iff the table changed. Raises
-        PoolExhausted (leaving existing pages intact) if the free list
-        can't cover the growth."""
+        missing pages (rc 1). Returns True iff the table changed. Raises
+        PoolExhausted (leaving existing pages intact) if the free list —
+        after asking the reclaimer to drop cold prefix pages — can't cover
+        the growth."""
         need = min(self.blocks_for(rows), self.max_blocks)
         have = int(self._blocks_used[slot])
         if need <= have:
             return False
-        grow = need - have
-        if grow > len(self._free):
-            raise PoolExhausted(grow, len(self._free))
+        self._take(need - have)
         for b in range(have, need):
-            self.tables[slot, b] = self._free.pop()
+            page = self._free.pop()
+            self._rc[page] = 1
+            self.tables[slot, b] = page
         self._blocks_used[slot] = need
         return True
 
+    def map_shared(self, slot: int, pages: Sequence[int]) -> None:
+        """Map already-resident pages (a matched prefix) as slot ``slot``'s
+        leading blocks, taking a reference on each. The slot must be empty
+        (fresh admission)."""
+        assert int(self._blocks_used[slot]) == 0, "slot must be empty"
+        for b, page in enumerate(pages):
+            self._rc[page] += 1
+            self.tables[slot, b] = page
+        self._blocks_used[slot] = len(pages)
+
+    def incref(self, page: int) -> None:
+        self._rc[page] += 1
+
+    def decref(self, page: int) -> None:
+        self._rc[page] -= 1
+        if self._rc[page] == 0:
+            self._free.append(page)
+        assert self._rc[page] >= 0, f"page {page} refcount underflow"
+
     def free_slot(self, slot: int) -> None:
-        """Return all of a slot's pages to the free list."""
+        """Drop the slot's reference on each of its pages; pages whose
+        refcount hits zero return to the free list (shared prefix pages
+        survive under their other owners / the prefix index)."""
         used = int(self._blocks_used[slot])
         for b in range(used):
-            self._free.append(int(self.tables[slot, b]))
+            self.decref(int(self.tables[slot, b]))
             self.tables[slot, b] = SACRIFICIAL_PAGE
         self._blocks_used[slot] = 0
 
     def slot_rows_backed(self, slot: int) -> int:
         return int(self._blocks_used[slot]) * self.page_size
+
+
+def chain_hashes(
+    token_ids: Sequence[int], page_size: int, num_blocks: int
+) -> List[bytes]:
+    """Content hash per full prompt block, chained so a block's hash
+    commits to everything before it — matching block b therefore matches
+    the entire prefix [0, (b+1)*P), which is exactly the K/V-equivalence
+    condition (K/V of a row depends on all rows before it).
+
+    sha256 over the token bytes, NOT Python's ``hash()``: the index key
+    decides whose K/V a request attends over, so a collision is silent
+    cross-request cache poisoning — and tuple ``hash()`` is analyzable
+    enough to craft collisions in a multi-tenant deployment."""
+    import hashlib
+
+    hashes: List[bytes] = []
+    h = b""
+    for b in range(num_blocks):
+        block = np.asarray(
+            token_ids[b * page_size : (b + 1) * page_size], np.int32
+        )
+        h = hashlib.sha256(h + block.tobytes()).digest()
+        hashes.append(h)
+    return hashes
+
+
+class PrefixIndex:
+    """Content-addressed cache of prompt-prefix pages (hash -> page).
+
+    Agent workloads resend the same system/task preamble constantly
+    (SURVEY.md section 3.1: every reasoning round rebuilds the prompt from
+    the same context); matching a prompt's leading full blocks against this
+    index turns their prefill into a table update — zero forward-pass
+    compute and zero new pages. The index holds one reference per cached
+    page, so pages survive their originating request; LRU eviction (and the
+    allocator's reclaimer hook, under pool pressure) drops the coldest
+    entries. Shared pages are read-only BY CONSTRUCTION: matches are capped
+    at the prompt's last full block minus one row, so every write a slot
+    performs (tail prefill, decode) lands at rows past the shared region.
+    """
+
+    def __init__(self, allocator: PageAllocator, max_pages: int) -> None:
+        self.alloc = allocator
+        self.max_pages = max_pages
+        self._index: "OrderedDict[int, int]" = OrderedDict()  # hash -> page
+        self.hits = 0
+        self.misses = 0
+        allocator.reclaimer = self.reclaim
+
+    def match(self, hashes: Sequence[int]) -> List[int]:
+        """Longest indexed prefix of ``hashes``; returns its pages (LRU
+        positions refreshed). No references are taken — the caller maps
+        them via ``PageAllocator.map_shared`` under the engine lock."""
+        pages: List[int] = []
+        for h in hashes:
+            page = self._index.get(h)
+            if page is None:
+                break
+            self._index.move_to_end(h)
+            pages.append(page)
+        if pages:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages
+
+    def put(self, hashes: Sequence[int], pages: Sequence[int]) -> None:
+        """Register freshly computed prefix blocks (one index reference
+        each); evicts LRU entries past ``max_pages``."""
+        for h, page in zip(hashes, pages):
+            if h in self._index:
+                self._index.move_to_end(h)
+                continue
+            self.alloc.incref(page)
+            self._index[h] = page
+        while len(self._index) > self.max_pages:
+            _, old = self._index.popitem(last=False)
+            self.alloc.decref(old)
+
+    def clear(self) -> None:
+        """Drop every entry (and its page reference)."""
+        while self._index:
+            _, page = self._index.popitem(last=False)
+            self.alloc.decref(page)
+
+    def reclaim(self, n: int) -> int:
+        """Drop up to ``n`` cold entries whose pages are held ONLY by the
+        index (rc 1) — called by the allocator when the free list runs
+        dry. Entries still shared by live slots are left alone."""
+        freed = 0
+        for h in list(self._index):
+            if freed >= n:
+                break
+            page = self._index[h]
+            if self.alloc._rc[page] == 1:
+                del self._index[h]
+                self.alloc.decref(page)
+                freed += 1
+        return freed

@@ -198,13 +198,20 @@ class ModelManager:
                 # kernel block and every power-of-two bucket >= 128. An
                 # indivisible context degrades to the dense cache (like
                 # every other invalid paged config) instead of failing load.
+                # AIOS_TPU_PREFIX_CACHE=0 disables prompt-prefix page
+                # sharing (on by default with the paged cache)
+                prefix = os.environ.get(
+                    "AIOS_TPU_PREFIX_CACHE", "1"
+                ).lower() not in ("0", "false", "off")
                 if ctx % 128 == 0:
                     kw = dict(
-                        paged_pool_rows=self.paged_pool_rows, page_size=128
+                        paged_pool_rows=self.paged_pool_rows, page_size=128,
+                        prefix_cache=prefix,
                     )
                 elif ctx % 16 == 0:
                     kw = dict(
-                        paged_pool_rows=self.paged_pool_rows, page_size=16
+                        paged_pool_rows=self.paged_pool_rows, page_size=16,
+                        prefix_cache=prefix,
                     )
                 else:
                     log.warning(

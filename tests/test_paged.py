@@ -254,7 +254,8 @@ def test_paged_chunked_prefill_interleaved_decode(params):
 def test_paged_chunked_admission_exhaustion_survives(params):
     """Mid-admission pool exhaustion must never kill the scheduler: either
     a victim is evicted or the admission itself fails cleanly."""
-    eng = make_paged(params, pool_rows=128, page_size=32, num_slots=2)
+    eng = make_paged(params, pool_rows=128, page_size=32, num_slots=2,
+                     prefix_cache=False)  # isolate the eviction policy
     b = ContinuousBatcher(eng, prefill_chunk=64)
     small = b.submit(Request(prompt_ids=[1, 2, 3], max_tokens=60,
                              temperature=0.0))
@@ -298,6 +299,131 @@ def test_batcher_evicts_longest_on_exhaustion(params):
     assert all(len(o) > 0 for o in outs)
     assert any(len(o) == 80 for o in outs)  # and someone ran to completion
     assert eng.allocator.pages_in_use() == 0
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# prefix caching
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_reuses_pages_and_matches_cold(params):
+    """Resubmitting a prompt must map its cached prefix pages instead of
+    recomputing them — and decode exactly the same tokens as a cold run."""
+    prompt = [int(t) for t in np.random.default_rng(7).integers(1, 500, 100)]
+    cold = make_paged(params)  # page_size 32: 100 tokens -> 3 full blocks
+    ref = cold.generate(prompt, max_new_tokens=24, temperature=0.0)
+    cold.close()
+
+    eng = make_paged(params)
+    first = eng.generate(prompt, max_new_tokens=24, temperature=0.0)
+    assert eng.prefix_rows_reused == 0  # cold: nothing to match
+    again = eng.generate(prompt, max_new_tokens=24, temperature=0.0)
+    assert eng.prefix_rows_reused == 96  # 3 x 32-row blocks mapped, not computed
+    assert eng.prefix_index.hits == 1
+    eng.close()
+    assert first == ref
+    assert again == ref
+
+
+def test_prefix_divergent_tails_share_only_common_blocks(params):
+    base = [int(t) for t in np.random.default_rng(8).integers(1, 500, 64)]
+    a, btail = base + [7, 8, 9], base + [11, 12, 13]
+    dense = make_dense(params)
+    ref_a = dense.generate(a, max_new_tokens=16, temperature=0.0)
+    ref_b = dense.generate(btail, max_new_tokens=16, temperature=0.0)
+    dense.close()
+
+    eng = make_paged(params)
+    got_a = eng.generate(a, max_new_tokens=16, temperature=0.0)
+    got_b = eng.generate(btail, max_new_tokens=16, temperature=0.0)
+    assert eng.prefix_rows_reused == 64  # the 2 shared base blocks
+    eng.close()
+    assert (got_a, got_b) == (ref_a, ref_b)
+
+
+def test_prefix_shared_pages_survive_owner_release(params):
+    """Slot A releases while slot B still maps the shared prefix — B's
+    decode must stay correct and the pages must not be recycled."""
+    prompt = [int(t) for t in np.random.default_rng(9).integers(1, 500, 80)]
+    dense = make_dense(params)
+    dense.prefill(1, prompt, temperature=0.0)
+    ref = dense.step(12)[:, 1].tolist()
+    dense.close()
+
+    eng = make_paged(params)
+    eng.prefill(0, prompt, temperature=0.0)  # registers blocks
+    eng.prefill(1, prompt, temperature=0.0)  # shares them
+    assert eng.prefix_rows_reused > 0
+    eng.release(0)  # owner goes away; index + slot 1 still hold refs
+    got = eng.step(12)[:, 1].tolist()
+    eng.close()
+    assert got == ref
+
+
+def test_prefix_hit_tail_overrun_is_safe(params):
+    """A prefix match de-aligns the tail's chunk starts, so the final
+    bucket's padding can overrun max_context (start=32 + bucket=512 > 512
+    here): the padded table slice must route overflow rows to the
+    sacrificial page instead of clamping a block early — output must match
+    the dense engine exactly."""
+    rng = np.random.default_rng(12)
+    base = [int(t) for t in rng.integers(1, 500, 40)]
+    y = base[:32] + [int(t) for t in rng.integers(1, 500, 479)]  # len 511
+    dense = make_dense(params, max_context=512)
+    ref = dense.generate(y, max_new_tokens=8, temperature=0.0)
+    dense.close()
+
+    eng = make_paged(params, pool_rows=1024, page_size=32, max_context=512)
+    eng.generate(base, max_new_tokens=4, temperature=0.0)  # registers block 0
+    got = eng.generate(y, max_new_tokens=8, temperature=0.0)
+    assert eng.prefix_rows_reused == 32  # the de-aligning 1-block match
+    eng.close()
+    assert got == ref
+
+
+def test_prefix_index_reclaims_under_pressure(params):
+    """Cold index pages are reclaimed instead of raising PoolExhausted."""
+    eng = make_paged(params, pool_rows=256, page_size=32, num_slots=2)
+    # fill the index: 3 distinct prompts x 2+ full blocks each
+    rng = np.random.default_rng(10)
+    for i in range(3):
+        p = [int(t) for t in rng.integers(1, 500, 70)]
+        eng.prefill(0, p, temperature=0.0)
+        eng.release(0)
+    assert eng.allocator.free_pages < 8  # index is holding pages
+    # a fresh prompt needing more pages than the free list has
+    big = [int(t) for t in rng.integers(1, 500, 200)]
+    first = eng.prefill(0, big, temperature=0.0)  # must NOT raise
+    assert 0 <= first < TINY_TEST.vocab_size
+    eng.close()
+
+
+def test_prefix_chunked_admission_hit(params):
+    """A long prompt resubmitted through chunked admission maps its prefix
+    and produces the dense engine's exact output."""
+    prompt = [int(t) for t in np.random.default_rng(11).integers(1, 500, 180)]
+    outs = {}
+    for paged in (False, True):
+        eng = make_paged(params) if paged else make_dense(params)
+        b = ContinuousBatcher(eng, prefill_chunk=64)
+        o1 = b.generate(prompt, max_tokens=12, temperature=0.0)
+        o2 = b.generate(prompt, max_tokens=12, temperature=0.0)
+        outs[paged] = (o1, o2)
+        if paged:
+            assert eng.prefix_rows_reused > 0
+        b.shutdown()
+        eng.close()
+    assert outs[True] == outs[False]
+
+
+def test_warmup_leaves_prefix_index_empty(params):
+    eng = make_paged(params, pool_rows=1024, page_size=32)
+    eng.warmup(step_sizes=(1,))
+    assert len(eng.prefix_index._index) == 0
+    assert eng.allocator.pages_in_use() == 0
+    out1 = eng.generate([1, 2, 3], max_new_tokens=8, temperature=0.0)
+    assert len(out1) == 8
     eng.close()
 
 
