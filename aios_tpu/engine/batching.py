@@ -387,9 +387,13 @@ class ContinuousBatcher:
         if self.speculative:
             # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
             # run in order; _emit retires requests mid-dispatch as usual
-            tokens, counts = self.engine.spec_step(
-                n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
-            )
+            try:
+                tokens, counts = self.engine.spec_step(
+                    n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
+                )
+            except PoolExhausted:
+                self._evict_longest()  # retry next tick, like the step path
+                return
             for r in range(tokens.shape[0]):
                 for slot, live in list(slots.items()):
                     if live.done:

@@ -293,7 +293,8 @@ class TPUEngine:
         return state, tokens  # tokens [n_steps, S]
 
     def _spec_impl(
-        self, params, state: DecodeState, n_rounds: int, draft_len: int, ngram: int
+        self, params, state: DecodeState, n_rounds: int, draft_len: int,
+        ngram: int, tables=None,
     ):
         """R speculative rounds in one dispatch: propose n-gram drafts from
         the device-resident history, verify them in a single multi-token
@@ -315,21 +316,33 @@ class TPUEngine:
             feed = jnp.concatenate(
                 [st["last_tokens"][:, None], drafts], axis=1
             )  # [S, K+1]
-            scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
-            out = model.verify_step(
-                params,
-                self.cfg,
-                feed,
-                st["lengths"],
-                st["k"],
-                st["v"],
-                cache_scales=scales,
-                active=st["active"],
-            )
-            if self.quant_cache:
-                logits, k, v, (k_s, v_s) = out
+            if self.paged:
+                logits, k, v = model.verify_step_paged(
+                    params,
+                    self.cfg,
+                    feed,
+                    st["lengths"],
+                    st["k"],
+                    st["v"],
+                    tables,
+                    active=st["active"],
+                )
             else:
-                logits, k, v = out
+                scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
+                out = model.verify_step(
+                    params,
+                    self.cfg,
+                    feed,
+                    st["lengths"],
+                    st["k"],
+                    st["v"],
+                    cache_scales=scales,
+                    active=st["active"],
+                )
+                if self.quant_cache:
+                    logits, k, v, (k_s, v_s) = out
+                else:
+                    logits, k, v = out
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
             a = spec.accept_counts(drafts, g)  # [S] in [0, K]
             key, sub = jax.random.split(st["key"])
@@ -556,9 +569,16 @@ class TPUEngine:
         key = (n_rounds, draft_len, ngram)
         fn = self._spec_fns.get(key)
         if fn is None:
-            fn = jax.jit(
-                lambda p, s: self._spec_impl(p, s, *key), donate_argnums=(1,)
-            )
+            if self.paged:
+                fn = jax.jit(
+                    lambda p, s, t: self._spec_impl(p, s, *key, tables=t),
+                    donate_argnums=(1,),
+                )
+            else:
+                fn = jax.jit(
+                    lambda p, s: self._spec_impl(p, s, *key),
+                    donate_argnums=(1,),
+                )
             self._spec_fns[key] = fn
         return fn
 
@@ -790,10 +810,6 @@ class TPUEngine:
         sequence; temp>0 slots never speculate and emit 1 sampled
         token/round. Only columns where ``self.active`` are meaningful.
         """
-        if self.paged:
-            raise ValueError(
-                "speculative decoding is not supported on a paged engine yet"
-            )
         # upper bound keeps active slots' history writes strictly below the
         # sacrificial last pad column reserved for inactive slots
         if not 1 <= draft_len <= spec.HISTORY_PAD - 2:
@@ -803,9 +819,25 @@ class TPUEngine:
         if ngram < 1:
             raise ValueError("ngram must be >= 1")
         with self._lock:
+            if self.paged:
+                # back the worst-case growth (full acceptance every round)
+                # up front; unused pages recycle at release
+                worst = n_rounds * (draft_len + 1)
+                for s in range(self.num_slots):
+                    if self.active[s]:
+                        self.allocator.ensure(
+                            s,
+                            min(
+                                int(self._host_lengths[s]) + worst,
+                                self.max_context,
+                            ),
+                        )
+                args = (jnp.asarray(self.allocator.tables),)
+            else:
+                args = ()
             self.state, (tokens, counts) = self._spec_fn(
                 n_rounds, draft_len, ngram
-            )(self.params, self.state)
+            )(self.params, self.state, *args)
             self.decode_steps += n_rounds
             counts = np.asarray(counts)
             self._host_lengths = np.minimum(

@@ -801,6 +801,68 @@ def decode_step_paged(
     return logits, k_pool, v_pool
 
 
+def verify_step_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 — [last_token, draft_0..draft_{T-2}]
+    lengths: jnp.ndarray,  # [B] int32
+    k_pool: jnp.ndarray,  # [L, N, P, KH, D]
+    v_pool: jnp.ndarray,  # [L, N, P, KH, D]
+    tables: jnp.ndarray,  # [B, MB] int32
+    active: Optional[jnp.ndarray] = None,  # [B] bool
+):
+    """``verify_step`` over the PAGED cache: the T in-flight rows scatter
+    through the page tables (inactive slots -> sacrificial page 0), and
+    attention reads each slot's gathered logical view with the same causal
+    mask. Same saturated-slot caveat as the dense version: rows clamped at
+    the cache end collide, so callers must not consume tokens from
+    saturated slots. The caller must have BACKED rows
+    ``lengths[b] .. lengths[b]+T-1`` for every active slot.
+
+    Returns (logits [B, T, V] fp32, k_pool', v_pool').
+    """
+    B, T = tokens.shape
+    MB = tables.shape[1]
+    P = k_pool.shape[2]
+    C = MB * P
+    if active is None:
+        active = jnp.ones((B,), jnp.bool_)
+    offs_t = jnp.arange(T)[None, :]
+    positions = lengths[:, None] + offs_t  # [B, T]
+    rows = jnp.minimum(positions, C - 1)
+    blk = rows // P
+    pages = jnp.take_along_axis(tables, blk, axis=1)  # [B, T] (tiny gather)
+    pages = jnp.where(active[:, None], pages, 0)
+    offs = jnp.where(active[:, None], rows % P, P - 1)
+    qpos = jnp.where(active[:, None], positions, 0)
+    cols = jnp.arange(C)[None, None, :]
+    mask = cols <= qpos[..., None]  # [B, T, C]
+    if cfg.sliding_window is not None:
+        mask = mask & (cols > (qpos[..., None] - cfg.sliding_window))
+
+    x = params["embed"][tokens]  # [B, T, E]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def block(x, layer):
+        lp, k_l, v_l = layer
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        k_l = k_l.at[pages, offs].set(k_new.astype(k_l.dtype))
+        v_l = v_l.at[pages, offs].set(v_new.astype(v_l.dtype))
+        # logical per-slot views; same HBM bytes as the dense masked read
+        k_all = k_l[tables].reshape(B, C, *k_l.shape[2:])
+        v_all = v_l[tables].reshape(B, C, *v_l.shape[2:])
+        attn = gqa_attention(q, k_all, v_all, mask)
+        x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
+        x = x + _mlp(x, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        block, x, (params["layers"], k_pool, v_pool)
+    )
+    logits = _final_logits(x, params, cfg)
+    return logits, k_pool, v_pool
+
+
 def verify_step(
     params: Params,
     cfg: ModelConfig,

@@ -231,13 +231,7 @@ class ModelManager:
             del params
             if self.warm_compile:
                 engine.warmup()
-            speculative = self.speculative and not engine.paged
-            if self.speculative and engine.paged:
-                log.warning(
-                    "AIOS_TPU_SPECULATIVE=1 ignored: speculative decoding "
-                    "is dense-only for now (paged KV enabled)"
-                )
-            batcher = ContinuousBatcher(engine, speculative=speculative)
+            batcher = ContinuousBatcher(engine, speculative=self.speculative)
             managed = ManagedModel(
                 name=name,
                 config=cfg,

@@ -303,6 +303,77 @@ def test_batcher_evicts_longest_on_exhaustion(params):
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding over the paged cache
+# ---------------------------------------------------------------------------
+
+
+def test_paged_spec_generate_matches_dense_and_plain(params):
+    prompt = [1, 2, 3]
+    dense = make_dense(params)
+    ref = dense.generate(prompt, max_new_tokens=64, temperature=0.0)
+    dense.close()
+    eng = make_paged(params)
+    got = eng.generate(
+        prompt, max_new_tokens=64, temperature=0.0, speculative=True
+    )
+    rounds = eng.decode_steps
+    eng.close()
+    assert got == ref
+    assert rounds < len(ref) - 1  # drafts actually accepted
+
+
+def test_paged_spec_backs_pages_for_accepted_runs(params):
+    """Full-draft acceptance grows lengths by K+1 per round — the worst
+    case must be page-backed up front so the scan can't write unbacked
+    rows."""
+    eng = make_paged(params, pool_rows=4 * 256, page_size=32)
+    eng.prefill(0, [5, 6, 5, 6, 5, 6, 5, 6], temperature=0.0)
+    for _ in range(6):
+        eng.spec_step(4, draft_len=7)
+    backed = eng.allocator.slot_rows_backed(0)
+    assert backed >= eng.slot_length(0) + 1
+    eng.close()
+
+
+def test_paged_spec_batcher_evicts_on_exhaustion(params):
+    """Speculative dispatches hit the same eviction policy as plain steps
+    when the worst-case growth can't be page-backed."""
+    eng = make_paged(params, pool_rows=96, page_size=32, num_slots=3,
+                     prefix_cache=False)
+    b = ContinuousBatcher(eng, speculative=True)
+    hs = [
+        b.submit(Request(prompt_ids=[s + 1, 2, 3], max_tokens=80,
+                         temperature=0.0))
+        for s in range(3)
+    ]
+    outs = [h.tokens() for h in hs]
+    b.shutdown()
+    assert b.last_error is None  # exhaustion evicted, never aborted
+    assert b.pool_evictions >= 1
+    assert all(len(o) > 0 for o in outs)
+    assert eng.allocator.pages_in_use() == 0
+    eng.close()
+
+
+def test_paged_prefix_plus_spec_agent_fast_path(params):
+    """The full agent fast path: resubmitted preamble maps cached pages,
+    then speculative rounds decode — output identical to the dense plain
+    engine."""
+    prompt = [int(t) for t in np.random.default_rng(13).integers(1, 500, 100)]
+    dense = make_dense(params)
+    ref = dense.generate(prompt, max_new_tokens=32, temperature=0.0)
+    dense.close()
+    eng = make_paged(params)
+    eng.generate(prompt, max_new_tokens=4, temperature=0.0)  # registers
+    got = eng.generate(
+        prompt, max_new_tokens=32, temperature=0.0, speculative=True
+    )
+    assert eng.prefix_rows_reused > 0
+    eng.close()
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
 # prefix caching
 # ---------------------------------------------------------------------------
 
