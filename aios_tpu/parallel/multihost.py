@@ -1,0 +1,181 @@
+"""Multi-host distributed backend: process group + global device mesh.
+
+The reference scales across nodes with gRPC-dispatched remote execution
+(orchestrator cluster manager + remote executor,
+/root/reference/agent-core/src/cluster.rs:1, remote_exec.rs:29-41) and
+leaves model execution single-node (one llama-server per host). Here the
+control plane stays exactly that gRPC cluster layer — but the *data
+plane* scales below the runtime service boundary the TPU way: one JAX
+process per host joins a process group (`jax.distributed`, the NCCL/MPI
+bootstrap equivalent), and a single GLOBAL mesh spans every host's chips.
+XLA then inserts the cross-host collectives: axes that span hosts ride
+DCN, axes inside a host ride ICI, and the same `ShardingPlan` /
+`make_train_step` / TP-decode code runs unchanged whether the mesh is one
+chip or a pod slice.
+
+Axis policy (the scaling-book recipe): the OUTER factor of `dp` spans
+hosts — data parallelism tolerates DCN latency because it communicates
+once per step (gradient all-reduce) — while `sp`/`tp` stay inside a
+host's ICI domain where per-layer collectives are cheap.
+
+Env contract (set by deploy scripts / systemd units, one process per
+host):
+  AIOS_TPU_COORDINATOR   host:port of process 0
+  AIOS_TPU_NUM_PROCESSES total process count
+  AIOS_TPU_PROCESS_ID    this process's rank
+  AIOS_TPU_MULTIHOST     "auto" => no-arg `jax.distributed.initialize()`
+                         (Cloud TPU pods self-describe their topology)
+
+Unset => single-host operation, no process group. The explicit
+coordinator contract is what the CPU e2e test and bare-metal deployments
+use; pods set only AIOS_TPU_MULTIHOST=auto.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+log = logging.getLogger("aios.multihost")
+
+_initialized = False
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto: bool = False,
+) -> bool:
+    """Join the process group. Returns True if a multi-process group was
+    initialized (idempotent; False means single-process operation).
+    ``auto=True`` with no coordinator calls the no-arg
+    ``jax.distributed.initialize()`` — Cloud TPU pods self-describe their
+    topology through the TPU metadata."""
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    if coordinator is None:
+        if not auto:
+            return False
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _initialized = True
+    log.info(
+        "joined process group: rank %d/%d via %s",
+        jax.process_index(), jax.process_count(), coordinator or "auto-detect",
+    )
+    return True
+
+
+def initialize_from_env() -> bool:
+    """Join the process group iff AIOS_TPU_COORDINATOR (explicit contract)
+    or AIOS_TPU_MULTIHOST=auto (pod auto-detect) is set — the service
+    startup hook; a no-op in the common single-host deployment."""
+    coord = os.environ.get("AIOS_TPU_COORDINATOR", "")
+    auto = os.environ.get("AIOS_TPU_MULTIHOST", "").lower() in ("1", "auto")
+    if not coord and not auto:
+        return False
+    num = os.environ.get("AIOS_TPU_NUM_PROCESSES")
+    pid = os.environ.get("AIOS_TPU_PROCESS_ID")
+    return initialize(
+        coord or None,
+        int(num) if num else None,
+        int(pid) if pid else None,
+        auto=auto,
+    )
+
+
+def build_global_mesh(dp: int = 0, sp: int = 1, tp: int = 1):
+    """A ("dp", "sp", "tp") mesh over EVERY process's devices, laid out so
+    dp's outer factor spans hosts (DCN) and sp/tp stay within a host
+    (ICI). dp=0 means "whatever is left". The result drops straight into
+    the existing ShardingPlan / train / TP-decode stack — multi-host scale
+    without touching any model code."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n_proc = jax.process_count()
+    local = jax.local_device_count()
+    total = n_proc * local
+    if sp * tp > local or local % (sp * tp):
+        raise ValueError(
+            f"sp*tp={sp * tp} must divide the {local} devices of one host "
+            f"— sp/tp collectives must ride ICI, never DCN"
+        )
+    local_dp = local // (sp * tp)
+    want_dp = n_proc * local_dp
+    if dp and dp != want_dp:
+        raise ValueError(
+            f"dp={dp} inconsistent: {n_proc} hosts x {local_dp} local dp "
+            f"gives {want_dp}"
+        )
+    if n_proc > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            devs = mesh_utils.create_hybrid_device_mesh(
+                (local_dp, sp, tp), (n_proc, 1, 1)
+            )
+            return Mesh(devs, ("dp", "sp", "tp"))
+        except Exception as e:  # noqa: BLE001 — CPU backends lack topology
+            log.debug("hybrid mesh unavailable (%s); process-sorted grid", e)
+        # group by process explicitly: devices sorted (process, local) so
+        # the dp axis's outer stride is the host boundary
+        devs = sorted(
+            jax.devices(), key=lambda d: (d.process_index, d.id)
+        )
+        grid = np.array(devs).reshape(n_proc * local_dp, sp, tp)
+        return Mesh(grid, ("dp", "sp", "tp"))
+    grid = np.array(jax.devices()[:total]).reshape(local_dp, sp, tp)
+    return Mesh(grid, ("dp", "sp", "tp"))
+
+
+def cross_host_allreduce_check(mesh) -> float:
+    """One psum across the full mesh — the data plane's liveness probe
+    (the collective analog of the reference cluster's TCP heartbeat,
+    cluster.rs:144-151). Each process contributes rank+1 once per local dp
+    shard, so the result on EVERY host must equal
+    ``sum(1..n_proc) * (local_device_count // (sp*tp))``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dp = mesh.shape["dp"]
+    contrib = np.full(
+        (n_dp // max(jax.process_count(), 1),),
+        float(jax.process_index() + 1),
+        np.float32,
+    )
+    sharding = NamedSharding(mesh, P(("dp",)))
+    arr = jax.make_array_from_process_local_data(sharding, contrib)
+
+    def f(x):
+        # the input varies over dp only (sp/tp replicate it), so dp is the
+        # axis the all-reduce must cross — which is exactly the axis that
+        # spans hosts
+        s = jax.lax.psum(x.sum(), "dp")
+        return s.reshape(1)
+
+    out = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P())
+    )(arr)
+    return float(jax.device_get(out)[0])
+
+
+def process_info() -> Tuple[int, int, int]:
+    """(process_index, process_count, local_device_count)."""
+    import jax
+
+    return jax.process_index(), jax.process_count(), jax.local_device_count()

@@ -125,17 +125,13 @@ class ModelManager:
                 quantize = sharding_plan is None and on_tpu
         self.quantize = bool(quantize) and sharding_plan is None
         # AIOS_TPU_KV_CACHE=int8 halves KV-cache footprint/traffic (the
-        # long-context + co-residency lever); default bf16
+        # long-context + co-residency lever); default bf16. Composes with a
+        # sharding plan: cache + scales shard by the plan's cache rules and
+        # the dequantizing attention partitions under GSPMD.
         kv_env = os.environ.get("AIOS_TPU_KV_CACHE", "").lower()
         self.cache_dtype = jnp.bfloat16
         if kv_env == "int8":
-            if sharding_plan is None:
-                self.cache_dtype = jnp.int8
-            else:
-                log.warning(
-                    "AIOS_TPU_KV_CACHE=int8 ignored: int8 KV cache is "
-                    "single-chip for now (sharding plan set); using bf16"
-                )
+            self.cache_dtype = jnp.int8
         elif kv_env and kv_env not in ("bf16", "bfloat16"):
             log.warning(
                 "unrecognized AIOS_TPU_KV_CACHE=%r (expected 'int8'); "

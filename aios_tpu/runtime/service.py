@@ -218,6 +218,12 @@ def serve(
 
 if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
+    # multi-host deployments set AIOS_TPU_COORDINATOR (+NUM_PROCESSES,
+    # +PROCESS_ID) so every host's runtime joins one process group and the
+    # engines see the global mesh; single-host is a no-op
+    from ..parallel import multihost
+
+    multihost.initialize_from_env()
     manager = ModelManager()
     manager.autoload()
     serve(manager=manager)

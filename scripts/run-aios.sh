@@ -7,6 +7,14 @@
 # gates, restart caps).
 #
 # Usage: scripts/run-aios.sh [--data-dir DIR] [--model-dir DIR] [--cpu]
+#
+# Multi-host (one invocation per TPU-VM host; the runtimes join one JAX
+# process group and serve over a single global mesh — dp across hosts on
+# DCN, sp/tp inside each host on ICI; aios_tpu/parallel/multihost.py):
+#   AIOS_TPU_COORDINATOR=host0:8476 AIOS_TPU_NUM_PROCESSES=4 \
+#   AIOS_TPU_PROCESS_ID=$RANK scripts/run-aios.sh
+# (on Cloud TPU pods set just AIOS_TPU_MULTIHOST=auto — the topology
+#  self-describes and jax.distributed.initialize() needs no arguments)
 set -euo pipefail
 
 REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
