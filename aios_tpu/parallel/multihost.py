@@ -86,6 +86,14 @@ def initialize_from_env() -> bool:
         return False
     num = os.environ.get("AIOS_TPU_NUM_PROCESSES")
     pid = os.environ.get("AIOS_TPU_PROCESS_ID")
+    if coord and not auto and not (num and pid is not None and pid != ""):
+        # fail with OUR contract in the message, not JAX's cluster-detect
+        # internals: the explicit coordinator path needs all three vars
+        raise ValueError(
+            "AIOS_TPU_COORDINATOR requires AIOS_TPU_NUM_PROCESSES and "
+            "AIOS_TPU_PROCESS_ID (or set AIOS_TPU_MULTIHOST=auto on a "
+            "self-describing Cloud TPU pod)"
+        )
     return initialize(
         coord or None,
         int(num) if num else None,

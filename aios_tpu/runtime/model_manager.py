@@ -122,8 +122,12 @@ class ModelManager:
                     on_tpu = jax.default_backend() == "tpu"
                 except Exception:  # noqa: BLE001
                     on_tpu = False
+                # default: int8 on single-chip TPU; sharded serving keeps
+                # the conservative bf16 default until measured on a real
+                # mesh — but an EXPLICIT AIOS_TPU_QUANTIZE=1 is honored
+                # either way (the engine shards the unfused int8 layout)
                 quantize = sharding_plan is None and on_tpu
-        self.quantize = bool(quantize) and sharding_plan is None
+        self.quantize = bool(quantize)
         # AIOS_TPU_KV_CACHE=int8 halves KV-cache footprint/traffic (the
         # long-context + co-residency lever); default bf16. Composes with a
         # sharding plan: cache + scales shard by the plan's cache rules and

@@ -98,6 +98,31 @@ def test_tp_int8_kv_cache_decode_matches_single_device(tiny_params, cpu_devices)
     assert got == want
 
 
+def test_tp_speculative_decode_matches_plain(tiny_params, cpu_devices):
+    """n-gram speculative rounds under a TP plan: the verify forward
+    partitions under GSPMD (the proposer/history are replicated state), so
+    greedy output must equal the plain sharded engine's exactly."""
+    prompt = [1, 2, 3]
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    ref = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=128,
+        cache_dtype=jnp.float32, shardings=plan,
+    )
+    want = ref.generate(prompt, max_new_tokens=48, temperature=0.0)
+    ref.close()
+    eng = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=128,
+        cache_dtype=jnp.float32, shardings=plan,
+    )
+    got = eng.generate(
+        prompt, max_new_tokens=48, temperature=0.0, speculative=True
+    )
+    rounds = eng.decode_steps
+    eng.close()
+    assert got == want
+    assert rounds < len(want) - 1  # drafts accepted across the mesh
+
+
 def test_sharded_ragged_attention_matches_gspmd(tiny_params, cpu_devices):
     """The shard_mapped per-device ragged decode attention (the path the
     Pallas kernel takes on a TPU mesh; jnp body here) must match the plain
