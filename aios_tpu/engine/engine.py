@@ -132,8 +132,6 @@ class TPUEngine:
         if self.paged:
             if shardings is not None:
                 raise ValueError("paged KV cache is single-chip for now")
-            if self.quant_cache:
-                raise ValueError("paged KV cache requires a bf16/f32 cache")
             if page_size < 1 or page_size & (page_size - 1):
                 # chunked admission relies on power-of-two chunk/page sizes
                 # never straddling (model.prefill_chunk_paged)
@@ -195,10 +193,20 @@ class TPUEngine:
             "key": jax.random.PRNGKey(seed),
         }
         if self.quant_cache:
-            k_s, v_s = model.init_kv_scales(cfg, num_slots, self.max_context)
-            if shardings is not None:
-                k_s = shardings.put_cache_scales(k_s)
-                v_s = shardings.put_cache_scales(v_s)
+            if self.paged:
+                # per-(page, row, kv-head) scales alongside the int8 pool
+                s_shape = (
+                    cfg.num_layers, k.shape[1], page_size, cfg.num_kv_heads,
+                )
+                k_s = jnp.ones(s_shape, jnp.float32)
+                v_s = jnp.ones(s_shape, jnp.float32)
+            else:
+                k_s, v_s = model.init_kv_scales(
+                    cfg, num_slots, self.max_context
+                )
+                if shardings is not None:
+                    k_s = shardings.put_cache_scales(k_s)
+                    v_s = shardings.put_cache_scales(v_s)
             self.state["k_s"] = k_s
             self.state["v_s"] = v_s
 
@@ -226,7 +234,10 @@ class TPUEngine:
             st = carry
             key, sub = jax.random.split(st["key"])
             if self.paged:
-                logits, k, v = model.decode_step_paged(
+                scales = (
+                    (st["k_s"], st["v_s"]) if self.quant_cache else None
+                )
+                out = model.decode_step_paged(
                     params,
                     self.cfg,
                     st["last_tokens"],
@@ -235,8 +246,13 @@ class TPUEngine:
                     st["v"],
                     tables,
                     kernels=self._kernels,
+                    cache_scales=scales,
                     active=st["active"],
                 )
+                if self.quant_cache:
+                    logits, k, v, (k_s, v_s) = out
+                else:
+                    logits, k, v = out
             elif self.quant_cache:
                 logits, k, v, (k_s, v_s) = model.decode_step(
                     params,
@@ -317,7 +333,10 @@ class TPUEngine:
                 [st["last_tokens"][:, None], drafts], axis=1
             )  # [S, K+1]
             if self.paged:
-                logits, k, v = model.verify_step_paged(
+                scales = (
+                    (st["k_s"], st["v_s"]) if self.quant_cache else None
+                )
+                out = model.verify_step_paged(
                     params,
                     self.cfg,
                     feed,
@@ -325,8 +344,13 @@ class TPUEngine:
                     st["k"],
                     st["v"],
                     tables,
+                    cache_scales=scales,
                     active=st["active"],
                 )
+                if self.quant_cache:
+                    logits, k, v, (k_s, v_s) = out
+                else:
+                    logits, k, v = out
             else:
                 scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
                 out = model.verify_step(
@@ -402,15 +426,27 @@ class TPUEngine:
         pages = jnp.repeat(table_row[:nb], P)[:T]  # [T]
         offs = jnp.arange(T) % P
         # ks/vs [L, 1, T, KH, D] -> pool [L, N, P, KH, D]
-        k = state["k"].at[:, pages, offs].set(ks[:, 0].astype(state["k"].dtype))
-        v = state["v"].at[:, pages, offs].set(vs[:, 0].astype(state["v"].dtype))
+        if self.quant_cache:
+            kq, ks_scale = model.quantize_kv(ks[:, 0])  # [L, T, KH, D/·]
+            vq, vs_scale = model.quantize_kv(vs[:, 0])
+            k = state["k"].at[:, pages, offs].set(kq)
+            v = state["v"].at[:, pages, offs].set(vq)
+            k_s = state["k_s"].at[:, pages, offs].set(ks_scale)
+            v_s = state["v_s"].at[:, pages, offs].set(vs_scale)
+        else:
+            k = state["k"].at[:, pages, offs].set(
+                ks[:, 0].astype(state["k"].dtype)
+            )
+            v = state["v"].at[:, pages, offs].set(
+                vs[:, 0].astype(state["v"].dtype)
+            )
         key, sub = jax.random.split(state["key"])
         last = logits[0, true_len - 1][None, :]  # [1, V]
         first = sampling.sample(last, sub, temp[None], top_p[None])[0]
         history = jax.lax.dynamic_update_slice(
             state["history"], tokens, (slot, jnp.int32(0))
         )
-        return {
+        out = {
             "k": k,
             "v": v,
             "lengths": state["lengths"].at[slot].set(true_len),
@@ -420,7 +456,11 @@ class TPUEngine:
             "active": state["active"].at[slot].set(True),
             "history": history.at[slot, true_len].set(first),
             "key": key,
-        }, first
+        }
+        if self.quant_cache:
+            out["k_s"] = k_s
+            out["v_s"] = v_s
+        return out, first
 
     def _prefill_impl(
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p
@@ -478,10 +518,15 @@ class TPUEngine:
         build on it."""
         upd: Dict[str, jnp.ndarray] = {}
         if self.paged:
-            logits, upd["k"], upd["v"] = model.prefill_chunk_paged(
+            scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
+            out = model.prefill_chunk_paged(
                 params, self.cfg, tokens, start, state["k"], state["v"],
-                table_row,
+                table_row, cache_scales=scales,
             )
+            if self.quant_cache:
+                logits, upd["k"], upd["v"], (upd["k_s"], upd["v_s"]) = out
+            else:
+                logits, upd["k"], upd["v"] = out
         else:
             scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
             out = model.prefill_chunk(

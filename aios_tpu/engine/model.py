@@ -659,6 +659,7 @@ def prefill_chunk_paged(
     k_pool: jnp.ndarray,  # [L, N, P, KH, D]
     v_pool: jnp.ndarray,  # [L, N, P, KH, D]
     table_row: jnp.ndarray,  # [MB] int32 — the slot's block->page map
+    cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ):
     """One chunk of an incremental prefill against the PAGED cache.
 
@@ -674,12 +675,15 @@ def prefill_chunk_paged(
     [0, start+Tc) — unbacked blocks map the sacrificial page 0, which the
     mask never exposes below ``start+Tc``.
 
-    Returns (logits [1, Tc, V] fp32, k_pool', v_pool').
+    ``cache_scales`` marks an int8 pool (rows quantize on write, the
+    gathered view dequantizes). Returns (logits [1, Tc, V] fp32, k_pool',
+    v_pool'[, scales']).
     """
     B, Tc = tokens.shape
     MB = table_row.shape[0]
     P = k_pool.shape[2]
     C_log = MB * P
+    quant_pool = cache_scales is not None
     x = params["embed"][tokens]  # [1, Tc, E]
     positions = start + jnp.arange(Tc)[None, :]  # [1, Tc]
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
@@ -706,12 +710,22 @@ def prefill_chunk_paged(
     kv_tile = t if C_log % t == 0 else P
 
     def block(x, layer):
-        lp, k_l, v_l = layer
+        if quant_pool:
+            lp, k_l, v_l, k_s, v_s = layer
+        else:
+            lp, k_l, v_l = layer
+            k_s = v_s = None
         q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
-        k_l = k_l.at[pages, offs].set(k_new[0].astype(k_l.dtype))
-        v_l = v_l.at[pages, offs].set(v_new[0].astype(v_l.dtype))
-        k_all = k_l[table_row].reshape(1, C_log, *k_l.shape[2:])
-        v_all = v_l[table_row].reshape(1, C_log, *v_l.shape[2:])
+        if quant_pool:
+            k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new[0])
+            v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new[0])
+            k_all = gather_dequant(k_l, k_s, table_row, q.dtype)[None]
+            v_all = gather_dequant(v_l, v_s, table_row, q.dtype)[None]
+        else:
+            k_l = k_l.at[pages, offs].set(k_new[0].astype(k_l.dtype))
+            v_l = v_l.at[pages, offs].set(v_new[0].astype(v_l.dtype))
+            k_all = k_l[table_row].reshape(1, C_log, *k_l.shape[2:])
+            v_all = v_l[table_row].reshape(1, C_log, *v_l.shape[2:])
         attn = blockwise_cache_attention(
             q,
             k_all.astype(q.dtype),
@@ -722,8 +736,17 @@ def prefill_chunk_paged(
         )
         x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"])
         x = x + _mlp(x, lp, cfg)
+        if quant_pool:
+            return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
 
+    if quant_pool:
+        k_scales, v_scales = cache_scales
+        x, (k_pool, v_pool, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["layers"], k_pool, v_pool, k_scales, v_scales)
+        )
+        logits = _final_logits(x, params, cfg)
+        return logits, k_pool, v_pool, (k_scales, v_scales)
     x, (k_pool, v_pool) = jax.lax.scan(
         block, x, (params["layers"], k_pool, v_pool)
     )
@@ -740,6 +763,7 @@ def decode_step_paged(
     v_pool: jnp.ndarray,  # [L, N, P, KH, D]
     tables: jnp.ndarray,  # [B, MB] int32 — logical block -> physical page
     kernels: Optional[bool] = None,
+    cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
 ):
     """One batched decode step over the PAGED slot cache.
@@ -753,11 +777,18 @@ def decode_step_paged(
     active slot (PageAllocator.ensure) — an unbacked entry maps page 0 and
     would silently cross-talk through the sacrificial page.
 
-    Returns (logits [B, V] fp32, k_pool', v_pool').
+    ``cache_scales`` — (k_scales, v_scales) [L, N, P, KH] f32 marks an
+    int8 POOL: rows quantize on write and the gathered per-slot view
+    dequantizes on read (the paged kernel reads bf16 pools only, so int8
+    stays on the gather path). Returns (logits [B, V] fp32, k_pool',
+    v_pool'[, (k_scales', v_scales')]).
     """
     B = tokens.shape[0]
+    MB = tables.shape[1]
     P = k_pool.shape[2]
-    use_kernel = _use_kernels(kernels)
+    C = MB * P
+    quant_pool = cache_scales is not None
+    use_kernel = _use_kernels(kernels) and not quant_pool
     if active is None:
         write_pages_of = lengths
         read_lengths = lengths
@@ -775,25 +806,57 @@ def decode_step_paged(
     x = params["embed"][tokens][:, None, :]  # [B, 1, E]
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
 
+    if quant_pool:  # layer-invariant mask, built once like decode_step's
+        cols = jnp.arange(C)[None, :]
+        mask = cols <= read_lengths[:, None]
+        if cfg.sliding_window is not None:
+            mask = mask & (
+                cols > (read_lengths[:, None] - cfg.sliding_window)
+            )
+        mask = mask[:, None, :]  # [B, 1, C]
+
     def block(x, layer):
-        lp, k_l, v_l = layer
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
-        k_l = k_l.at[pages, offs].set(k_new[:, 0].astype(k_l.dtype))
-        v_l = v_l.at[pages, offs].set(v_new[:, 0].astype(v_l.dtype))
-        if use_kernel:
-            attn = ops.paged_decode_attention(
-                q[:, 0], k_l, v_l, tables, read_lengths,
-                window=cfg.sliding_window,
-            )[:, None]
+        if quant_pool:
+            lp, k_l, v_l, k_s, v_s = layer
         else:
-            attn = ops.paged_decode_attention_reference(
-                q[:, 0], k_l, v_l, tables, read_lengths,
-                window=cfg.sliding_window,
-            )[:, None]
+            lp, k_l, v_l = layer
+            k_s = v_s = None
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        if quant_pool:
+            k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new[:, 0])
+            v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new[:, 0])
+            attn = gqa_attention(
+                q,
+                gather_dequant(k_l, k_s, tables, q.dtype),
+                gather_dequant(v_l, v_s, tables, q.dtype),
+                mask,
+            )
+        else:
+            k_l = k_l.at[pages, offs].set(k_new[:, 0].astype(k_l.dtype))
+            v_l = v_l.at[pages, offs].set(v_new[:, 0].astype(v_l.dtype))
+            if use_kernel:
+                attn = ops.paged_decode_attention(
+                    q[:, 0], k_l, v_l, tables, read_lengths,
+                    window=cfg.sliding_window,
+                )[:, None]
+            else:
+                attn = ops.paged_decode_attention_reference(
+                    q[:, 0], k_l, v_l, tables, read_lengths,
+                    window=cfg.sliding_window,
+                )[:, None]
         x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
         x = x + _mlp(x, lp, cfg)
+        if quant_pool:
+            return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
 
+    if quant_pool:
+        k_scales, v_scales = cache_scales
+        x, (k_pool, v_pool, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["layers"], k_pool, v_pool, k_scales, v_scales)
+        )
+        logits = _final_logits(x[:, 0], params, cfg)
+        return logits, k_pool, v_pool, (k_scales, v_scales)
     x, (k_pool, v_pool) = jax.lax.scan(
         block, x, (params["layers"], k_pool, v_pool)
     )
@@ -809,6 +872,7 @@ def verify_step_paged(
     k_pool: jnp.ndarray,  # [L, N, P, KH, D]
     v_pool: jnp.ndarray,  # [L, N, P, KH, D]
     tables: jnp.ndarray,  # [B, MB] int32
+    cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
 ):
     """``verify_step`` over the PAGED cache: the T in-flight rows scatter
@@ -818,13 +882,15 @@ def verify_step_paged(
     the cache end collide, so callers must not consume tokens from
     saturated slots. The caller must have BACKED rows
     ``lengths[b] .. lengths[b]+T-1`` for every active slot.
+    ``cache_scales`` marks an int8 pool.
 
-    Returns (logits [B, T, V] fp32, k_pool', v_pool').
+    Returns (logits [B, T, V] fp32, k_pool', v_pool'[, scales']).
     """
     B, T = tokens.shape
     MB = tables.shape[1]
     P = k_pool.shape[2]
     C = MB * P
+    quant_pool = cache_scales is not None
     if active is None:
         active = jnp.ones((B,), jnp.bool_)
     offs_t = jnp.arange(T)[None, :]
@@ -844,18 +910,38 @@ def verify_step_paged(
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     def block(x, layer):
-        lp, k_l, v_l = layer
+        if quant_pool:
+            lp, k_l, v_l, k_s, v_s = layer
+        else:
+            lp, k_l, v_l = layer
+            k_s = v_s = None
         q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
-        k_l = k_l.at[pages, offs].set(k_new.astype(k_l.dtype))
-        v_l = v_l.at[pages, offs].set(v_new.astype(v_l.dtype))
-        # logical per-slot views; same HBM bytes as the dense masked read
-        k_all = k_l[tables].reshape(B, C, *k_l.shape[2:])
-        v_all = v_l[tables].reshape(B, C, *v_l.shape[2:])
+        if quant_pool:
+            k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new)
+            v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new)
+            k_all = gather_dequant(k_l, k_s, tables, q.dtype)
+            v_all = gather_dequant(v_l, v_s, tables, q.dtype)
+        else:
+            k_l = k_l.at[pages, offs].set(k_new.astype(k_l.dtype))
+            v_l = v_l.at[pages, offs].set(v_new.astype(v_l.dtype))
+            # logical per-slot views; same HBM bytes as the dense masked
+            # read
+            k_all = k_l[tables].reshape(B, C, *k_l.shape[2:])
+            v_all = v_l[tables].reshape(B, C, *v_l.shape[2:])
         attn = gqa_attention(q, k_all, v_all, mask)
         x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
         x = x + _mlp(x, lp, cfg)
+        if quant_pool:
+            return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
 
+    if quant_pool:
+        k_scales, v_scales = cache_scales
+        x, (k_pool, v_pool, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["layers"], k_pool, v_pool, k_scales, v_scales)
+        )
+        logits = _final_logits(x, params, cfg)
+        return logits, k_pool, v_pool, (k_scales, v_scales)
     x, (k_pool, v_pool) = jax.lax.scan(
         block, x, (params["layers"], k_pool, v_pool)
     )
@@ -1088,6 +1174,33 @@ def init_kv_scales(
     """Per-(row, kv-head) scales for an int8 KV cache."""
     shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads)
     return jnp.ones(shape, jnp.float32), jnp.ones(shape, jnp.float32)
+
+
+def scatter_quant(
+    pool: jnp.ndarray,  # [N, P, KH, D] int8
+    scales: jnp.ndarray,  # [N, P, KH] f32
+    pages: jnp.ndarray,
+    offs: jnp.ndarray,
+    rows: jnp.ndarray,  # [..., KH, D] new rows (pages/offs broadcast-match)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize rows and scatter values + scales into an int8 page pool —
+    the single write-side quantization contract for every paged path."""
+    q, s = quantize_kv(rows)
+    return pool.at[pages, offs].set(q), scales.at[pages, offs].set(s)
+
+
+def gather_dequant(
+    pool: jnp.ndarray,  # [N, P, KH, D] int8
+    scales: jnp.ndarray,  # [N, P, KH] f32
+    tables: jnp.ndarray,  # [..., MB] int32
+    dtype,
+) -> jnp.ndarray:
+    """Materialize dequantized logical views [..., MB*P, KH, D] from an
+    int8 page pool — the read-side twin of ``scatter_quant``."""
+    out = dequantize_kv(pool[tables], scales[tables], dtype)
+    MB = tables.shape[-1]
+    P, KH, D = pool.shape[1], pool.shape[2], pool.shape[3]
+    return out.reshape(*tables.shape[:-1], MB * P, KH, D)
 
 
 def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -185,12 +185,6 @@ class ModelManager:
         try:
             cfg, params, tokenizer = self._load_weights(name, path, context_length)
             cache_dtype = self.cache_dtype
-            if self.paged_pool_rows is not None and cache_dtype == jnp.int8:
-                log.warning(
-                    "AIOS_TPU_KV_CACHE=int8 ignored: paged KV cache is "
-                    "bf16-only for now"
-                )
-                cache_dtype = jnp.bfloat16
             ctx = context_length or cfg.max_context
             kw = {}
             if self.paged_pool_rows is not None:

@@ -303,6 +303,57 @@ def test_batcher_evicts_longest_on_exhaustion(params):
 
 
 # ---------------------------------------------------------------------------
+# int8 pool
+# ---------------------------------------------------------------------------
+
+
+def test_paged_int8_pool_matches_dense_int8(params):
+    """int8 paged pool must decode exactly like the dense int8 KV cache —
+    same quantizer on write, same dequantized values on read."""
+    prompt = [3, 17, 91, 4, 55, 8]
+    dense = make_dense(params, cache_dtype=jnp.int8)
+    ref = dense.generate(prompt, max_new_tokens=24, temperature=0.0)
+    dense.close()
+    eng = make_paged(params, cache_dtype=jnp.int8)
+    got = eng.generate(prompt, max_new_tokens=24, temperature=0.0)
+    eng.close()
+    assert got == ref
+
+
+def test_paged_int8_chunked_and_prefix(params):
+    """Chunk admission and prefix reuse both run over the int8 pool."""
+    prompt = [int(t) for t in np.random.default_rng(14).integers(1, 500, 150)]
+    dense = make_dense(params, cache_dtype=jnp.int8)
+    ref = dense.generate(prompt, max_new_tokens=16, temperature=0.0)
+    dense.close()
+    eng = make_paged(params, cache_dtype=jnp.int8)
+    pc = eng.start_chunked_prefill(0, prompt, temperature=0.0, chunk=64)
+    first = None
+    while first is None:
+        first = pc.step()
+    got = [first] + [int(t) for t in eng.step(15)[:, 0]]
+    eng.release(0)
+    hit = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    assert eng.prefix_rows_reused > 0
+    eng.close()
+    assert got == ref
+    assert hit == ref
+
+
+def test_paged_int8_speculative(params):
+    prompt = [1, 2, 3]
+    dense = make_dense(params, cache_dtype=jnp.int8)
+    ref = dense.generate(prompt, max_new_tokens=48, temperature=0.0)
+    dense.close()
+    eng = make_paged(params, cache_dtype=jnp.int8)
+    got = eng.generate(
+        prompt, max_new_tokens=48, temperature=0.0, speculative=True
+    )
+    eng.close()
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
 # speculative decoding over the paged cache
 # ---------------------------------------------------------------------------
 
