@@ -233,8 +233,21 @@ class ContinuousBatcher:
             live.slot = slot
             ids = live.req.prompt_ids
             alloc = self.engine.allocator
+            need_rows = min(len(ids), self.engine.max_context - 1)
+            window = self.engine.cfg.sliding_window
+            if (
+                alloc is not None
+                and window is not None
+                and self.prefill_chunk is not None
+            ):
+                # chunked admission on windowed models trims as it goes —
+                # peak residency is window + one in-flight chunk (plus a
+                # page of straddle), not the whole prompt
+                need_rows = min(
+                    need_rows, window + self.prefill_chunk + alloc.page_size
+                )
             if alloc is not None and alloc.blocks_for(
-                min(len(ids), self.engine.max_context - 1)
+                need_rows
             ) > alloc.num_pages - 1:
                 # the prompt can NEVER fit the pool — fail it up front;
                 # evicting live requests one per tick would truncate every

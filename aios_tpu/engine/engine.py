@@ -666,6 +666,28 @@ class TPUEngine:
             )
             pos += len(seg)
 
+    def _back_active_slots(self, grow_rows: int) -> None:
+        """Back every active slot's next ``grow_rows`` rows BEFORE a paged
+        dispatch (PoolExhausted surfaces with state untouched so the
+        batcher can retire a victim and retry); windowed models first
+        return pages attention can no longer reach. Caller holds the
+        engine lock."""
+        for s in range(self.num_slots):
+            if self.active[s]:
+                if self.cfg.sliding_window is not None:
+                    self.allocator.trim_below_window(
+                        s,
+                        int(self._host_lengths[s]),
+                        self.cfg.sliding_window,
+                    )
+                self.allocator.ensure(
+                    s,
+                    min(
+                        int(self._host_lengths[s]) + grow_rows,
+                        self.max_context,
+                    ),
+                )
+
     # -- prefix caching (paged engines; paged.PrefixIndex) ------------------
 
     def _match_prefix(self, slot: int, ids: List[int]):
@@ -701,6 +723,11 @@ class TPUEngine:
         prompt blocks to the index so the NEXT prompt with this prefix
         skips their prefill. Caller holds the engine lock."""
         if self.prefix_index is None or not hashes:
+            return
+        if int(self.allocator._trimmed[slot]):
+            # sliding-window trimming released leading blocks during this
+            # admission; their table entries are stale and a prefix chain
+            # must start at block 0 — nothing registrable
             return
         pages = [int(self.allocator.tables[slot, b]) for b in range(len(hashes))]
         self.prefix_index.put(hashes, pages)
@@ -817,18 +844,7 @@ class TPUEngine:
         """
         with self._lock:
             if self.paged:
-                # back every active slot's next n rows BEFORE dispatching;
-                # PoolExhausted surfaces here (state untouched) so the
-                # batcher can retire a victim and retry
-                for s in range(self.num_slots):
-                    if self.active[s]:
-                        self.allocator.ensure(
-                            s,
-                            min(
-                                int(self._host_lengths[s]) + n_steps,
-                                self.max_context,
-                            ),
-                        )
+                self._back_active_slots(n_steps)
                 self.state, tokens = self._step_fn(n_steps)(
                     self.params, self.state, jnp.asarray(self.allocator.tables)
                 )
@@ -865,18 +881,9 @@ class TPUEngine:
             raise ValueError("ngram must be >= 1")
         with self._lock:
             if self.paged:
-                # back the worst-case growth (full acceptance every round)
-                # up front; unused pages recycle at release
-                worst = n_rounds * (draft_len + 1)
-                for s in range(self.num_slots):
-                    if self.active[s]:
-                        self.allocator.ensure(
-                            s,
-                            min(
-                                int(self._host_lengths[s]) + worst,
-                                self.max_context,
-                            ),
-                        )
+                # worst case: full acceptance every round; unused pages
+                # recycle at release
+                self._back_active_slots(n_rounds * (draft_len + 1))
                 args = (jnp.asarray(self.allocator.tables),)
             else:
                 args = ()
@@ -1139,7 +1146,15 @@ class ChunkedPrefill:
             extra = ()
             if eng.paged:
                 # back this chunk's rows before dispatching; PoolExhausted
-                # surfaces to the batcher with all state untouched
+                # surfaces to the batcher with all state untouched. On
+                # windowed models, blocks the remaining chunks can no
+                # longer attend to free as admission advances — a 64k
+                # prompt's residency is bounded by the window, not the
+                # prompt (registration then skips the trimmed slot).
+                if eng.cfg.sliding_window is not None:
+                    eng.allocator.trim_below_window(
+                        self.slot, self.pos, eng.cfg.sliding_window
+                    )
                 eng.allocator.ensure(self.slot, self.pos + n)
                 extra = (jnp.asarray(eng.allocator.tables[self.slot]),)
             if final:

@@ -58,6 +58,9 @@ class PageAllocator:
         self.tables = np.full((num_slots, max_blocks), SACRIFICIAL_PAGE,
                               dtype=np.int32)
         self._blocks_used = np.zeros(num_slots, dtype=np.int64)
+        # leading blocks already released by sliding-window trimming; their
+        # table entries are stale-but-unread until the slot frees
+        self._trimmed = np.zeros(num_slots, dtype=np.int64)
         # pages mapped by more than one owner (prefix sharing) carry a
         # refcount; rc 0 means free
         self._rc = np.zeros(num_pages, dtype=np.int64)
@@ -123,10 +126,32 @@ class PageAllocator:
         refcount hits zero return to the free list (shared prefix pages
         survive under their other owners / the prefix index)."""
         used = int(self._blocks_used[slot])
-        for b in range(used):
+        for b in range(self._trimmed[slot], used):
             self.decref(int(self.tables[slot, b]))
-            self.tables[slot, b] = SACRIFICIAL_PAGE
+        # trimmed entries were already decref'd — just restore the
+        # "unbacked maps page 0" invariant for the whole row
+        self.tables[slot, :used] = SACRIFICIAL_PAGE
         self._blocks_used[slot] = 0
+        self._trimmed[slot] = 0
+
+    def trim_below_window(self, slot: int, length: int, window: int) -> int:
+        """Release the slot's leading blocks that sliding-window attention
+        can never read again: block b is dead once its last row
+        ``(b+1)*P - 1`` falls below ``length - window`` (window starts only
+        move forward, so this is monotone-safe — the reader masks/skips
+        those blocks already; ops/paged_attention.py start_blk). The table
+        entries keep their stale page ids, which is fine: they are never
+        read and ``ensure`` never rewinds. Returns blocks freed now."""
+        used = int(self._blocks_used[slot])
+        dead_rows = max(length - window, 0)
+        dead = min(dead_rows // self.page_size, used)
+        freed = 0
+        for b in range(self._trimmed[slot], dead):
+            self.decref(int(self.tables[slot, b]))
+            freed += 1
+        if dead > self._trimmed[slot]:
+            self._trimmed[slot] = dead
+        return freed
 
     def slot_rows_backed(self, slot: int) -> int:
         return int(self._blocks_used[slot]) * self.page_size
