@@ -61,18 +61,31 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     optimizer: Optional[optax.GradientTransformation] = None,
     remat: bool = True,
+    seq_parallel: str = "ring",
 ) -> Tuple[Callable, Callable]:
     """Returns (init_state, train_step), both jittable.
 
-    With a mesh whose `sp` axis is >1, attention runs as ring attention
-    (sequence-parallel); otherwise in-core GQA attention. Batches are
+    With a mesh whose `sp` axis is >1, attention runs sequence-parallel —
+    ``seq_parallel`` picks the sharding: "ring" (K/V rotate via ppermute;
+    bandwidth-optimal at very long T) or "ulysses" (two all-to-alls swap
+    sequence<->head sharding; wins at modest sp with plentiful heads, needs
+    heads % sp == 0). Otherwise in-core GQA attention. Batches are
     dicts {"tokens": [B, T] int32, "loss_mask": [B, T] float32} where
     position t's label is tokens[t+1] (last column is ignored).
     """
     optimizer = optimizer or make_optimizer()
+    if seq_parallel not in ("ring", "ulysses"):
+        raise ValueError(
+            f"seq_parallel must be 'ring' or 'ulysses', got {seq_parallel!r}"
+        )
     attn_fn = None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        attn_fn = make_ring_attn_fn(mesh)
+        if seq_parallel == "ring":
+            attn_fn = make_ring_attn_fn(mesh, window=cfg.sliding_window)
+        else:
+            from ..parallel.ulysses import make_ulysses_attn_fn
+
+            attn_fn = make_ulysses_attn_fn(mesh, window=cfg.sliding_window)
 
     # kernels=False: the Pallas flash kernel is forward-only; training must
     # take the differentiable XLA attention (or the explicit ring attn_fn)

@@ -17,6 +17,7 @@ jit, composing with the dp/tp axes of the same mesh.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,14 +33,41 @@ def _block_scores(q, k, scale):
     return jnp.einsum("btkgd,bskd->bkgts", q, k).astype(jnp.float32) * scale
 
 
+def visibility(rows, cols, window):
+    """Causal (optionally sliding-window) visibility in GLOBAL positions —
+    the one mask rule both sequence-parallel attentions apply per tile."""
+    vis = rows[:, None] >= cols[None, :]
+    if window is not None:
+        vis = vis & (cols[None, :] > rows[:, None] - window)
+    return vis
+
+
+def fold_tile(carry, scores, visible, v_tile):
+    """One online-softmax (flash) accumulation step over a KV tile, shared
+    by ring and Ulysses sequence parallelism. carry = (m, l, acc) with
+    shapes [B,KH,G,Tq] / [B,KH,G,Tq] / [B,KH,G,Tq,D]; scores [B,KH,G,Tq,Tk]
+    fp32; visible [Tq, Tk]; v_tile [B,Tk,KH,D]."""
+    m, l, acc = carry
+    scores = jnp.where(visible[None, None, None], scores, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.where(visible[None, None, None], p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bkgts,bskd->bkgtd", p, v_tile.astype(jnp.float32))
+    return m_new, l_new, acc * alpha[..., None] + pv
+
+
 def ring_attention(
     q: jnp.ndarray,  # [B, T, H, D]   T sharded over `axis`
     k: jnp.ndarray,  # [B, T, KH, D]
     v: jnp.ndarray,  # [B, T, KH, D]
     mesh: Mesh,
     axis: str = "sp",
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
-    """Causal GQA ring attention; returns [B, T, H, D] sharded like q."""
+    """Causal (optionally sliding-window) GQA ring attention; returns
+    [B, T, H, D] sharded like q."""
     B, T, H, D = q.shape
     KH = k.shape[2]
     G = H // KH
@@ -68,18 +96,10 @@ def ring_attention(
             k_cur, v_cur, m, l, acc = carry
             src_blk = (my - s) % n_ring  # which global block we hold now
             cols = src_blk * Tk + jnp.arange(Tk)
-            mask = rows[:, None] >= cols[None, :]  # causal, global coords
+            vis = visibility(rows, cols, window)  # global coords
 
             scores = _block_scores(qg, k_cur, scale)  # [B,KH,G,Tq,Tk]
-            scores = jnp.where(mask[None, None, None], scores, NEG_INF)
-
-            blk_max = jnp.max(scores, axis=-1)  # [B,KH,G,Tq]
-            new_m = jnp.maximum(m, blk_max)
-            correction = jnp.exp(m - new_m)
-            p = jnp.exp(scores - new_m[..., None])  # [B,KH,G,Tq,Tk]
-            new_l = l * correction + p.sum(axis=-1)
-            pv = jnp.einsum("bkgts,bskd->bkgtd", p, v_cur.astype(jnp.float32))
-            new_acc = acc * correction[..., None] + pv
+            new_m, new_l, new_acc = fold_tile((m, l, acc), scores, vis, v_cur)
 
             # rotate k/v one hop around the ring (device d -> d+1)
             perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
@@ -100,11 +120,14 @@ def ring_attention(
     return _ring(q, k, v)
 
 
-def make_ring_attn_fn(mesh: Mesh, axis: str = "sp"):
-    """Adapter matching model.py's attention signature (mask is recomputed
-    internally from global positions, so the passed mask is ignored)."""
+def make_ring_attn_fn(mesh: Mesh, axis: str = "sp",
+                      window: Optional[int] = None):
+    """Adapter matching model.py's attention signature (the causal /
+    sliding-window mask is recomputed internally from GLOBAL positions, so
+    the passed local mask is ignored — callers must forward the model's
+    window here, as make_train_step does)."""
 
-    def attn(q, k, v, mask):  # noqa: ARG001 — causality handled in-ring
-        return ring_attention(q, k, v, mesh, axis)
+    def attn(q, k, v, mask):  # noqa: ARG001 — masking handled in-ring
+        return ring_attention(q, k, v, mesh, axis, window=window)
 
     return attn

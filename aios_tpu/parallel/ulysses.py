@@ -1,0 +1,125 @@
+"""Ulysses (all-to-all) sequence parallelism over the `sp` mesh axis.
+
+The second of the two standard long-context shardings (the task brief's
+"ring attention OR all-to-all sequence/context parallelism"); ring
+(`ring_attention.py`) rotates K/V around the chips and is
+bandwidth-optimal at very long T, Ulysses re-shards once per attention:
+two `all_to_all`s swap the sharded axis from sequence to heads, every
+device then runs plain causal attention over the FULL sequence for its
+head group, and a final all_to_all swaps back. Communication is
+O(T·H·D / sp) per a2a regardless of ring hops, which wins when sp is
+modest and heads are plentiful; the attention math itself stays the
+single-device kind, so it inherits any local attention optimizations for
+free.
+
+Constraints: both `num_heads` and `num_kv_heads` must divide by the sp
+axis (the head split must respect GQA group boundaries). The reference
+has no counterpart (fixed 2048-8192 contexts, SURVEY.md section 5
+"long-context: absent").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import fold_tile, visibility
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, T, H, D]   T sharded over `axis`
+    k: jnp.ndarray,  # [B, T, KH, D]
+    v: jnp.ndarray,  # [B, T, KH, D]
+    mesh: Mesh,
+    axis: str = "sp",
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Causal GQA attention, sequence-parallel via two all-to-alls.
+
+    Returns [B, T, H, D] sharded like q. Inside the shard_map the local
+    attention runs a blockwise online softmax over KV tiles so the
+    [T, T] score matrix never materializes (same recurrence as ring /
+    flash).
+    """
+    B, T, H, D = q.shape
+    KH = k.shape[2]
+    G = H // KH
+    sp = mesh.shape[axis]
+    if H % sp or KH % sp:
+        raise ValueError(
+            f"num_heads {H} and num_kv_heads {KH} must divide the sp axis "
+            f"({sp}) — the all-to-all splits heads across it"
+        )
+    scale = 1.0 / np.sqrt(D)
+
+    spec = P(None, axis, None, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    def _uly(q_blk, k_blk, v_blk):
+        # [B, T/sp, H, D] -> [B, T, H/sp, D]: gather sequence, split heads
+        qh = jax.lax.all_to_all(
+            q_blk, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+        kh = jax.lax.all_to_all(
+            k_blk, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+        vh = jax.lax.all_to_all(
+            v_blk, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+        Hl, KHl = H // sp, KH // sp
+        qg = qh.reshape(B, T, KHl, G, D).astype(jnp.float32) * scale
+        tile = T // sp  # reuse the natural shard size as the KV tile
+        kb = kh.astype(jnp.float32).reshape(B, sp, tile, KHl, D)
+        vb = vh.astype(jnp.float32).reshape(B, sp, tile, KHl, D)
+        rows = jnp.arange(T)
+
+        def fold(carry, xs):
+            k_t, v_t, cols = xs  # [B, tile, KHl, D], [tile]
+            s = jnp.einsum("btkgd,bskd->bkgts", qg, k_t)
+            vis = visibility(rows, cols, window)
+            return fold_tile(carry, s, vis, v_t), None
+
+        cols = rows.reshape(sp, tile)
+        init = (
+            jnp.full((B, KHl, G, T), -1e30, jnp.float32),
+            jnp.zeros((B, KHl, G, T), jnp.float32),
+            jnp.zeros((B, KHl, G, T, D), jnp.float32),
+        )
+        (m, l, acc), _ = jax.lax.scan(
+            fold, init, (kb.transpose(1, 0, 2, 3, 4),
+                         vb.transpose(1, 0, 2, 3, 4), cols)
+        )
+        out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B,KHl,G,T,D]
+        out = out.transpose(0, 3, 1, 2, 4).reshape(B, T, Hl, D)
+        # [B, T, H/sp, D] -> [B, T/sp, H, D]: split sequence, gather heads
+        out = jax.lax.all_to_all(
+            out, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+        return out.astype(q_blk.dtype)
+
+    return _uly(q, k, v)
+
+
+def make_ulysses_attn_fn(mesh: Mesh, axis: str = "sp",
+                         window: Optional[int] = None):
+    """Adapter matching model.py's attention signature (the causal /
+    sliding-window mask is applied internally from GLOBAL positions; the
+    passed local mask is ignored — callers must forward the model's
+    window, as make_train_step does)."""
+
+    def attn(q, k, v, mask):  # noqa: ARG001
+        return ulysses_attention(q, k, v, mesh, axis, window=window)
+
+    return attn
