@@ -220,6 +220,8 @@ class TPUEngine:
         self._spec_fns: Dict[Tuple[int, int, int], object] = {}
         self.decode_steps = 0
         self.prefix_rows_reused = 0
+        self.spec_rounds = 0
+        self.spec_tokens = 0
 
     # -- jitted cores -------------------------------------------------------
 
@@ -892,6 +894,8 @@ class TPUEngine:
             )(self.params, self.state, *args)
             self.decode_steps += n_rounds
             counts = np.asarray(counts)
+            self.spec_rounds += n_rounds
+            self.spec_tokens += int(counts[:, self.active].sum())
             self._host_lengths = np.minimum(
                 self._host_lengths + counts.sum(axis=0), self.max_context - 1
             )
@@ -908,6 +912,28 @@ class TPUEngine:
 
     def slot_length(self, slot: int) -> int:
         return int(self._host_lengths[slot])
+
+    def stats(self) -> Dict[str, float]:
+        """Serving counters for observability (HealthCheck details, the
+        monitoring agent's metric push — the reference's llama-server
+        exposes nothing comparable)."""
+        out: Dict[str, float] = {
+            "decode_steps": self.decode_steps,
+            "active_slots": int(self.active.sum()),
+        }
+        if self.spec_rounds:
+            out["spec_rounds"] = self.spec_rounds
+            out["spec_tokens_per_round"] = round(
+                self.spec_tokens / self.spec_rounds, 2
+            )
+        if self.allocator is not None:
+            out["kv_pages_in_use"] = self.allocator.pages_in_use()
+            out["kv_pages_free"] = self.allocator.free_pages
+        if self.prefix_index is not None:
+            out["prefix_hits"] = self.prefix_index.hits
+            out["prefix_misses"] = self.prefix_index.misses
+            out["prefix_rows_reused"] = self.prefix_rows_reused
+        return out
 
     def close(self) -> None:
         """Release device memory NOW. The jitted step fns close over

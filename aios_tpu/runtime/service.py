@@ -79,6 +79,20 @@ class RuntimeService(AIRuntimeServicer):
             m.name: m.state for m in self.manager.models.values()
         }
         details["backend"] = "jax-tpu"
+        # per-model serving counters (spec acceptance, KV page usage,
+        # prefix-cache hits, evictions) — additive observability the
+        # reference's llama-server health probe has no equivalent for
+        for m in self.manager.models.values():
+            # snapshot: a concurrent UnloadModel nulls these fields on the
+            # same ManagedModel object mid-iteration
+            engine, batcher = m.engine, m.batcher
+            if engine is not None and batcher is not None:
+                stats = dict(engine.stats())
+                stats["pool_evictions"] = batcher.pool_evictions
+                stats["completed"] = batcher.completed
+                details[f"{m.name}.serving"] = ",".join(
+                    f"{k}={v}" for k, v in sorted(stats.items())
+                )
         ready = len(self.manager.ready_models())
         return common_pb2.HealthStatus(
             healthy=True,

@@ -118,6 +118,10 @@ def test_health_reports_models(runtime_stub):
     assert h.healthy
     assert h.details["backend"] == "jax-tpu"
     assert h.details["tinyllama-test"] == "ready"
+    # serving counters ride the details map (additive observability)
+    serving = h.details["tinyllama-test.serving"]
+    assert "decode_steps=" in serving
+    assert "completed=" in serving
 
 
 def test_unload_model(runtime_stub):
