@@ -18,6 +18,7 @@ import statistics
 import time
 from typing import Any, Dict, List
 
+from ..proto_gen import common_pb2
 from .base import BaseAgent
 
 
@@ -235,12 +236,18 @@ class MonitoringAgent(BaseAgent):
         return ["monitor", "hw"]
 
     def observe(self, key: str, value: float) -> bool:
-        """Record a point; True if it is anomalous vs the rolling baseline."""
+        """Record a point; True if it is anomalous vs the rolling baseline.
+
+        The stdev floor is scale-proportional, not epsilon: counters that
+        sit perfectly flat while idle (KV pages free overnight) would
+        otherwise flag the first 1-unit move after a zero-variance
+        baseline as a 3-sigma event and spam anomalies on every routine
+        transition."""
         hist = self._history[key]
         anomalous = False
         if len(hist) >= 10:
             mean = statistics.fmean(hist)
-            stdev = statistics.pstdev(hist) or 1e-9
+            stdev = max(statistics.pstdev(hist), 0.01 * abs(mean), 1e-9)
             anomalous = abs(value - mean) > self.ANOMALY_SIGMA * stdev
         hist.append(value)
         return anomalous
@@ -257,6 +264,39 @@ class MonitoringAgent(BaseAgent):
                     {"metric": key, "value": value},
                     critical=value > 95,
                 )
+        self.collect_serving_metrics()
+
+    def collect_serving_metrics(self) -> None:
+        """Scrape the TPU runtime's per-model serving counters
+        (HealthCheck `<model>.serving` details: spec acceptance, KV page
+        usage, prefix hits — runtime/service.py) into the memory service's
+        metric store, with the same rolling-baseline anomaly detection as
+        the system metrics. Quietly skips when the runtime is down — its
+        own health is the health checker's job."""
+        try:
+            h = self.runtime.HealthCheck(common_pb2.Empty(), timeout=5)
+            items = list(h.details.items())
+        except Exception:  # noqa: BLE001 — runtime absent/restarting
+            return
+        for key, blob in items:
+            if not key.endswith(".serving"):
+                continue
+            model = key[: -len(".serving")]
+            for pair in blob.split(","):
+                name, _, raw = pair.partition("=")
+                try:
+                    value = float(raw)
+                except ValueError:
+                    continue
+                metric = f"runtime.{model}.{name}"
+                self.update_metric(metric, value)
+                if name in ("kv_pages_free", "spec_tokens_per_round"):
+                    if self.observe(metric, value):
+                        self.push_event(
+                            "monitoring.anomaly",
+                            {"metric": metric, "value": value},
+                            critical=name == "kv_pages_free" and value == 0,
+                        )
 
     def handle_task(self, task: Dict[str, Any]) -> Dict[str, Any]:
         desc = task["description"].lower()

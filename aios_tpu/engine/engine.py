@@ -222,6 +222,7 @@ class TPUEngine:
         self.prefix_rows_reused = 0
         self.spec_rounds = 0
         self.spec_tokens = 0
+        self.spec_slot_rounds = 0
 
     # -- jitted cores -------------------------------------------------------
 
@@ -896,6 +897,9 @@ class TPUEngine:
             counts = np.asarray(counts)
             self.spec_rounds += n_rounds
             self.spec_tokens += int(counts[:, self.active].sum())
+            # acceptance denominator: (round, active-slot) pairs — a
+            # per-slot rate that doesn't scale with batch occupancy
+            self.spec_slot_rounds += n_rounds * int(self.active.sum())
             self._host_lengths = np.minimum(
                 self._host_lengths + counts.sum(axis=0), self.max_context - 1
             )
@@ -923,8 +927,10 @@ class TPUEngine:
         }
         if self.spec_rounds:
             out["spec_rounds"] = self.spec_rounds
+            # mean tokens emitted per slot per verify round (1.0 = nothing
+            # accepted; draft_len+1 = every draft accepted)
             out["spec_tokens_per_round"] = round(
-                self.spec_tokens / self.spec_rounds, 2
+                self.spec_tokens / max(self.spec_slot_rounds, 1), 2
             )
         if self.allocator is not None:
             out["kv_pages_in_use"] = self.allocator.pages_in_use()

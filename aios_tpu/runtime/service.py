@@ -75,19 +75,19 @@ class RuntimeService(AIRuntimeServicer):
         )
 
     def HealthCheck(self, request, context):
-        details = {
-            m.name: m.state for m in self.manager.models.values()
-        }
+        # list(): Load/Unload RPCs mutate the dict on other gRPC threads
+        models = list(self.manager.models.values())
+        details = {m.name: m.state for m in models}
         details["backend"] = "jax-tpu"
         # per-model serving counters (spec acceptance, KV page usage,
         # prefix-cache hits, evictions) — additive observability the
         # reference's llama-server health probe has no equivalent for
-        for m in self.manager.models.values():
+        for m in models:
             # snapshot: a concurrent UnloadModel nulls these fields on the
             # same ManagedModel object mid-iteration
             engine, batcher = m.engine, m.batcher
             if engine is not None and batcher is not None:
-                stats = dict(engine.stats())
+                stats = engine.stats()
                 stats["pool_evictions"] = batcher.pool_evictions
                 stats["completed"] = batcher.completed
                 details[f"{m.name}.serving"] = ",".join(

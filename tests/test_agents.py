@@ -175,6 +175,47 @@ def test_monitoring_agent_anomaly_detection():
     assert not a.observe("cpu", 20.5)
 
 
+def test_monitoring_agent_scrapes_runtime_serving_stats():
+    """The serving counters in the runtime HealthCheck details land in the
+    metric store under runtime.<model>.*, with anomaly events on pool
+    exhaustion."""
+    from aios_tpu.proto_gen import common_pb2
+
+    a = MonitoringAgent(name="mon-t2")
+    health = common_pb2.HealthStatus(healthy=True, service="runtime")
+    health.details["tiny"] = "ready"
+    health.details["tiny.serving"] = (
+        "decode_steps=42,kv_pages_free=0,spec_tokens_per_round=3.5"
+    )
+    stub = MagicMock()
+    stub.HealthCheck.return_value = health
+    a._stubs = {"runtime": stub}
+    metrics, events = {}, []
+    a.update_metric = lambda k, v: metrics.__setitem__(k, v)
+    a.push_event = lambda cat, data, critical=False: events.append(
+        (cat, data, critical)
+    )
+    # observe() needs a baseline before flagging; prime kv_pages_free high
+    for _ in range(20):
+        a.observe("runtime.tiny.kv_pages_free", 50.0)
+    a.collect_serving_metrics()
+    assert metrics["runtime.tiny.decode_steps"] == 42.0
+    assert metrics["runtime.tiny.spec_tokens_per_round"] == 3.5
+    # pool hit zero against a healthy baseline -> critical anomaly
+    assert any(
+        data["metric"] == "runtime.tiny.kv_pages_free" and critical
+        for _, data, critical in events
+    )
+
+
+def test_monitoring_agent_serving_scrape_survives_runtime_down():
+    a = MonitoringAgent(name="mon-t3")
+    stub = MagicMock()
+    stub.HealthCheck.side_effect = RuntimeError("unavailable")
+    a._stubs = {"runtime": stub}
+    a.collect_serving_metrics()  # must not raise
+
+
 def test_learning_agent_stores_recurring_patterns():
     a = agent_class("learning")(name="learn-t")
     a.get_recent_events = lambda count=100: (
