@@ -363,6 +363,7 @@ class TPUEngine:
                     st["lengths"],
                     st["k"],
                     st["v"],
+                    kernels=self._kernels,
                     cache_scales=scales,
                     active=st["active"],
                 )

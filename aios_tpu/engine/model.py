@@ -376,6 +376,24 @@ def _ragged_min_c() -> int:
         return 2048
 
 
+def _use_ragged_kernel(
+    kernels: Optional[bool], C: int, cfg: ModelConfig, quant_cache: bool
+) -> bool:
+    """The ragged-attention crossover, shared by decode_step and
+    verify_step: the kernel's DMA-only-valid-rows win beats its per-layer
+    launch cost either on a long cache outright (>= _ragged_min_c rows,
+    the TinyLlama-measured crossover) or on a large-model cache whose
+    C x (KH x D) slab is >= 1 MiB of rows per slot (Mistral-7B at 1k rows
+    measures +11% whole-step throughput on v5e). The kernels read bf16
+    caches only, so int8-KV paths stay on XLA."""
+    kv_row = cfg.num_kv_heads * cfg.head_dim
+    return (
+        _use_kernels(kernels)
+        and (C >= _ragged_min_c() or C * kv_row >= 1 << 20)
+        and not quant_cache
+    )
+
+
 def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=None):
     B, T = tokens.shape
     x = params["embed"][tokens]
@@ -559,21 +577,8 @@ def decode_step(
     B = tokens.shape[0]
     C = k_cache.shape[2]
     quant_cache = cache_scales is not None
-    # The ragged kernel's DMA-only-valid-rows win beats its per-layer launch
-    # cost once the cache is long; below that XLA's fused full-cache read is
-    # faster (measured crossover on v5e around 2k rows). The kernel reads
-    # bf16 caches only, so the int8-cache path stays on XLA.
-    # The ragged kernel wins when the cache bytes it avoids streaming beat
-    # its per-layer launch cost: either a long cache outright (>= 2k rows,
-    # the TinyLlama-measured crossover) or a large-model cache whose
-    # C x (KH x D) slab is >= 1 MiB of rows per slot (Mistral-7B at 1k rows
-    # measures +11% whole-step throughput on v5e).
-    kv_row = cfg.num_kv_heads * cfg.head_dim
-    use_kernel = (
-        attn_impl is None
-        and _use_kernels(kernels)
-        and (C >= _ragged_min_c() or C * kv_row >= 1 << 20)
-        and not quant_cache
+    use_kernel = attn_impl is None and _use_ragged_kernel(
+        kernels, C, cfg, quant_cache
     )
     if active is None:
         write_rows = lengths
@@ -956,6 +961,7 @@ def verify_step(
     lengths: jnp.ndarray,  # [B] int32 — tokens already in each slot's cache
     k_cache: jnp.ndarray,  # [L, B, C, KH, D]
     v_cache: jnp.ndarray,  # [L, B, C, KH, D]
+    kernels: Optional[bool] = None,
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
 ):
@@ -1002,10 +1008,22 @@ def verify_step(
     # inactive slots expose only (overwritten-before-read) col 0, matching
     # the decode_step convention
     qpos = jnp.where(active[:, None], positions, 0)  # [B, T]
-    cols = jnp.arange(C)[None, None, :]  # [1, 1, C]
-    mask = cols <= qpos[..., None]  # [B, T, C]
-    if cfg.sliding_window is not None:
-        mask = mask & (cols > (qpos[..., None] - cfg.sliding_window))
+    # Ragged multi-query kernel: DMAs only the blocks holding valid rows,
+    # same crossover rule as decode_step's single-query kernel
+    # (_use_ragged_kernel); bf16 cache only. Saturated slots run through
+    # whichever path the batch takes with clamped/colliding rows — their
+    # outputs are unconsumed by the saturation contract above; the kernel
+    # clamps its DMA bound at the cache end so the VALID slots stay exact.
+    use_kernel = _use_ragged_kernel(kernels, C, cfg, quant_cache)
+    if use_kernel:
+        mask = None
+        strides = active.astype(jnp.int32)
+        read_base = jnp.where(active, lengths, 0)
+    else:
+        cols = jnp.arange(C)[None, None, :]  # [1, 1, C]
+        mask = cols <= qpos[..., None]  # [B, T, C]
+        if cfg.sliding_window is not None:
+            mask = mask & (cols > (qpos[..., None] - cfg.sliding_window))
 
     x = params["embed"][tokens]  # [B, T, E]
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
@@ -1034,7 +1052,13 @@ def verify_step(
         else:
             k_l = k_l.at[batch_idx, write_rows].set(k_new.astype(k_l.dtype))
             v_l = v_l.at[batch_idx, write_rows].set(v_new.astype(v_l.dtype))
-            attn = gqa_attention(q, k_l, v_l, mask)
+            if use_kernel:
+                attn = ops.multiquery_decode_attention(
+                    q, k_l, v_l, read_base, strides,
+                    window=cfg.sliding_window,
+                )
+            else:
+                attn = gqa_attention(q, k_l, v_l, mask)
         x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
         x = x + _mlp(x, lp, cfg)
         if quant_cache:

@@ -41,6 +41,10 @@ from .quantized_matmul import (
     quantized_matmul,
     quantized_matmul_reference,
 )
+from .verify_attention import (
+    multiquery_decode_attention,
+    multiquery_decode_attention_reference,
+)
 
 __all__ = [
     "flash_attention",
@@ -50,6 +54,8 @@ __all__ = [
     "paged_decode_attention",
     "paged_decode_attention_reference",
     "gather_pages",
+    "multiquery_decode_attention",
+    "multiquery_decode_attention_reference",
     "quantize_int8",
     "dequantize",
     "quantized_matmul",

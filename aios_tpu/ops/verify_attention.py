@@ -1,0 +1,231 @@
+"""Ragged MULTI-QUERY decode attention: T in-flight queries per slot.
+
+The speculative verify step scores a slot's pending token plus K draft
+tokens in one forward (model.verify_step). Its attention is T queries per
+slot over that slot's valid cache rows — without a kernel it falls back to
+a full-cache masked read, paying C-row HBM traffic per slot regardless of
+how short the slot actually is. This kernel generalizes the single-query
+ragged decode kernel (decode_attention.py): same double-buffered
+HBM→VMEM DMA over only the blocks that hold valid rows, but each block is
+scored against all T queries, with the causal staircase applied per query
+(query t sees cols <= base + t·stride).
+
+``stride`` is 1 for active slots and 0 for inactive ones, matching
+verify_step's convention that inactive slots expose only the
+overwritten-before-read col 0 for every query.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mq_kernel(
+    len_ref,  # SMEM [B] int32 — base: row `len` holds query 0's row
+    stride_ref,  # SMEM [B] int32 — 1 active (staircase), 0 inactive
+    q_ref,  # VMEM [1, T, H, D]
+    k_hbm,  # ANY  [B, C, KH*D]
+    v_hbm,  # ANY  [B, C, KH*D]
+    o_ref,  # VMEM [1, T, H, D]
+    *,
+    num_kv_heads: int,
+    head_dim: int,
+    block_kv: int,
+    window: Optional[int],
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    KH, D, bk = num_kv_heads, head_dim, block_kv
+    T, H = q_ref.shape[1], q_ref.shape[2]
+    G = H // KH
+
+    base = len_ref[b]
+    stride = stride_ref[b]
+    C = k_hbm.shape[1]
+    # rows [0, base + (T-1)*stride] are visible to SOME query; clamp at the
+    # cache end — a saturated slot's clamped writes collide there and its
+    # outputs are unconsumed by contract, but the DMA must stay in bounds
+    total = jnp.minimum(base + (T - 1) * stride + 1, C)
+    n_blk = pl.cdiv(total, bk)
+    if window is not None:
+        # earliest col any query needs is query 0's window start
+        start_blk = jnp.maximum(base + 1 - window, 0) // bk
+    else:
+        start_blk = jnp.int32(0)
+
+    # [T*G, D] per kv head, rows ordered (t, g)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [T, H, D]
+    qpos = base + jnp.arange(T) * stride  # [T] each query's own row
+
+    def body(k_buf, v_buf, sems):
+        def dma(buf_hbm, scr, slot, blk, sem_idx):
+            return pltpu.make_async_copy(
+                buf_hbm.at[b, pl.ds(blk * bk, bk)],
+                scr.at[slot],
+                sems.at[slot, sem_idx],
+            )
+
+        dma(k_hbm, k_buf, 0, start_blk, 0).start()
+        dma(v_hbm, v_buf, 0, start_blk, 1).start()
+
+        def loop(i, carry):
+            m, l, acc = carry  # [KH*T*G, 1], [KH*T*G, 1], [KH*T*G, D]
+            slot = jax.lax.rem(i - start_blk, 2)
+
+            @pl.when(i + 1 < n_blk)
+            def _prefetch():
+                nxt = 1 - slot
+                dma(k_hbm, k_buf, nxt, i + 1, 0).start()
+                dma(v_hbm, v_buf, nxt, i + 1, 1).start()
+
+            dma(k_hbm, k_buf, slot, i, 0).wait()
+            dma(v_hbm, v_buf, slot, i, 1).wait()
+            kb = k_buf[slot]  # [bk, KH*D]
+            vb = v_buf[slot]
+
+            cols = i * bk + jax.lax.broadcasted_iota(jnp.int32, (T, bk), 1)
+            valid = cols <= qpos[:, None]  # causal staircase per query
+            if window is not None:
+                valid = jnp.logical_and(valid, cols > qpos[:, None] - window)
+            # [T, bk] -> [T*G, bk] (repeat per query's G heads)
+            validg = jnp.repeat(valid, G, axis=0)
+
+            parts = []
+            for h in range(KH):
+                qh = q[:, h * G : (h + 1) * G, :].reshape(T * G, D)
+                kh = kb[:, h * D : (h + 1) * D]
+                s = jax.lax.dot_general(
+                    qh, kh, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [T*G, bk]
+                parts.append(jnp.where(validg, s, NEG_INF))
+            s_all = jnp.concatenate(parts, axis=0)  # [KH*T*G, bk]
+
+            m_cur = jnp.max(s_all, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(s_all - m_new)
+            p = jnp.where(
+                jnp.concatenate([validg] * KH, axis=0), p, 0.0
+            )
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+            outs = []
+            for h in range(KH):
+                ph = p[h * T * G : (h + 1) * T * G, :].astype(vb.dtype)
+                vh = vb[:, h * D : (h + 1) * D]
+                outs.append(
+                    jax.lax.dot_general(
+                        ph, vh, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            acc_new = acc * alpha + jnp.concatenate(outs, axis=0)
+            return m_new, l_new, acc_new
+
+        init = (
+            jnp.full((KH * T * G, 1), NEG_INF, jnp.float32),
+            jnp.zeros((KH * T * G, 1), jnp.float32),
+            jnp.zeros((KH * T * G, D), jnp.float32),
+        )
+        m, l, acc = jax.lax.fori_loop(start_blk, n_blk, loop, init)
+        safe_l = jnp.where(l <= 0.0, 1.0, l)
+        out = acc / safe_l  # [KH*T*G, D]
+        out = out.reshape(KH, T, G, D).transpose(1, 0, 2, 3)
+        o_ref[0] = out.reshape(T, H, D).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        k_buf=pltpu.VMEM((2, bk, KH * D), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, bk, KH * D), v_hbm.dtype),
+        sems=pltpu.SemaphoreType.DMA((2, 2)),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "block_kv", "interpret")
+)
+def multiquery_decode_attention(
+    q: jnp.ndarray,  # [B, T, H, D] — T in-flight queries per slot
+    k_cache: jnp.ndarray,  # [B, C, KH, D]
+    v_cache: jnp.ndarray,  # [B, C, KH, D]
+    lengths: jnp.ndarray,  # [B] int32 — query 0's own (just-written) row
+    strides: jnp.ndarray,  # [B] int32 — 1 active, 0 inactive
+    *,
+    window: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged multi-query decode attention; returns [B, T, H, D]."""
+    from .decode_attention import pick_block_kv
+
+    B, T, H, D = q.shape
+    C, KH = k_cache.shape[1], k_cache.shape[2]
+    bk = pick_block_kv(C) if block_kv is None else min(block_kv, C)
+    if C % bk:
+        raise ValueError(f"block_kv {bk} must evenly divide cache length {C}")
+
+    kernel = functools.partial(
+        _mq_kernel,
+        num_kv_heads=KH,
+        head_dim=D,
+        block_kv=bk,
+        window=window,
+        sm_scale=1.0 / float(np.sqrt(D)),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # strides
+            pl.BlockSpec((1, T, H, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, T, H, D), lambda b: (b, 0, 0, 0)),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        strides.astype(jnp.int32),
+        q,
+        k_cache.reshape(B, C, KH * D),
+        v_cache.reshape(B, C, KH * D),
+    )
+
+
+def multiquery_decode_attention_reference(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    strides: jnp.ndarray,
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Naive jnp multi-query ragged attention (CPU fallback + parity)."""
+    B, T, H, D = q.shape
+    C, KH = k_cache.shape[1], k_cache.shape[2]
+    G = H // KH
+    qpos = lengths[:, None] + jnp.arange(T)[None, :] * strides[:, None]
+    cols = jnp.arange(C)[None, None, :]
+    mask = cols <= qpos[..., None]  # [B, T, C]
+    if window is not None:
+        mask = mask & (cols > qpos[..., None] - window)
+    qg = q.reshape(B, T, KH, G, D)
+    s = jnp.einsum("btkgd,bckd->bkgtc", qg, k_cache).astype(jnp.float32)
+    s = s / np.sqrt(D)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgtc,bckd->btkgd", p, v_cache)
+    return out.reshape(B, T, H, D)
