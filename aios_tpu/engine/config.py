@@ -29,6 +29,21 @@ class ModelConfig:
     sliding_window: Optional[int] = None
     tie_word_embeddings: bool = False
     qk_norm: bool = False  # Qwen3-style per-head RMSNorm on q/k
+    # Mixture-of-experts (0 experts = dense FFN). The router picks
+    # num_experts_per_tok experts per token; their gate weights are softmax
+    # probabilities renormalized over the selected set when norm_topk_prob.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None
+    norm_topk_prob: bool = True
+
+    @property
+    def moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def expert_dim(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
     @property
     def q_dim(self) -> int:
@@ -49,10 +64,29 @@ class ModelConfig:
         """Approximate parameter count (embeddings + blocks + head)."""
         e = self.vocab_size * self.hidden_size
         attn = self.hidden_size * self.q_dim * 2 + self.hidden_size * self.kv_dim * 2
-        mlp = 3 * self.hidden_size * self.intermediate_size
+        if self.moe:
+            mlp = self.hidden_size * self.num_experts + (
+                self.num_experts * 3 * self.hidden_size * self.expert_dim
+            )
+        else:
+            mlp = 3 * self.hidden_size * self.intermediate_size
         norms = 2 * self.hidden_size
         head = 0 if self.tie_word_embeddings else e
         return e + self.num_layers * (attn + mlp + norms) + self.hidden_size + head
+
+    def active_params(self) -> int:
+        """Params touched per token (MoE: only the routed experts' FFNs) —
+        the number that sets decode FLOPs, vs num_params() which sets HBM
+        footprint."""
+        if not self.moe:
+            return self.num_params()
+        e = self.vocab_size * self.hidden_size
+        attn = self.hidden_size * self.q_dim * 2 + self.hidden_size * self.kv_dim * 2
+        mlp = self.hidden_size * self.num_experts + (
+            self.num_experts_per_tok * 3 * self.hidden_size * self.expert_dim
+        )
+        head = 0 if self.tie_word_embeddings else e
+        return e + self.num_layers * (attn + mlp) + head
 
 
 # ---------------------------------------------------------------------------
@@ -115,8 +149,52 @@ QWEN3_14B = ModelConfig(
     qk_norm=True,
 )
 
+QWEN3_30B_A3B = ModelConfig(
+    # The MoE tier the reference only reaches via the cloud gateway
+    # (qwen3:30b-128k @ api.viwoapp.net, api-gateway/src/main.rs:70-88):
+    # served locally here — 30B params in HBM, ~3B active per token.
+    name="qwen3-30b-a3b",
+    vocab_size=151936,
+    hidden_size=2048,
+    intermediate_size=6144,
+    num_layers=48,
+    num_heads=32,
+    num_kv_heads=4,
+    head_dim=128,
+    max_context=32768,
+    rope_theta=1000000.0,
+    rms_norm_eps=1e-6,
+    qk_norm=True,
+    num_experts=128,
+    num_experts_per_tok=8,
+    moe_intermediate_size=768,
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    max_context=32768,
+    rope_theta=1000000.0,
+    num_experts=8,
+    num_experts_per_tok=2,
+)
+
 PRESETS: Dict[str, ModelConfig] = {
-    c.name: c for c in (TINYLLAMA_1_1B, MISTRAL_7B, DEEPSEEK_R1_8B, QWEN3_14B)
+    c.name: c
+    for c in (
+        TINYLLAMA_1_1B,
+        MISTRAL_7B,
+        DEEPSEEK_R1_8B,
+        QWEN3_14B,
+        QWEN3_30B_A3B,
+        MIXTRAL_8X7B,
+    )
 }
 
 # Tiny variants for tests / dry runs (same code paths, trivial sizes).
@@ -131,6 +209,21 @@ TINY_TEST = ModelConfig(
     num_kv_heads=2,
     head_dim=16,
     max_context=128,
+)
+
+TINY_MOE = ModelConfig(
+    name="tiny-moe",
+    vocab_size=512,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    max_context=128,
+    num_experts=4,
+    num_experts_per_tok=2,
+    moe_intermediate_size=32,
 )
 
 
@@ -160,7 +253,16 @@ def from_gguf_metadata(md: Dict[str, Any]) -> ModelConfig:
     vocab = int(md.get("tokenizer.ggml.tokens and vocab", 0)) or len(
         md.get("tokenizer.ggml.tokens", [])
     ) or int(key("vocab_size", 32000))
+    num_experts = int(key("expert_count", 0) or 0)
     return ModelConfig(
+        num_experts=num_experts,
+        num_experts_per_tok=int(key("expert_used_count", 2) or 2),
+        moe_intermediate_size=(
+            int(key("expert_feed_forward_length"))
+            if key("expert_feed_forward_length")
+            else None
+        ),
+        norm_topk_prob=bool(key("expert_weights_norm", True)),
         name=md.get("general.name", arch).lower().replace(" ", "-"),
         vocab_size=vocab,
         hidden_size=hidden,
@@ -180,8 +282,11 @@ def from_gguf_metadata(md: Dict[str, Any]) -> ModelConfig:
 
 
 def from_hf_config(hf: Dict[str, Any], name: str = "hf-model") -> ModelConfig:
-    """Build a config from a HuggingFace config dict (Llama/Mistral/Qwen3)."""
+    """Build a config from a HuggingFace config dict
+    (Llama/Mistral/Qwen3/Mixtral/Qwen3-MoE)."""
     heads = hf["num_attention_heads"]
+    # num_local_experts (mixtral) / num_experts (qwen3_moe)
+    num_experts = hf.get("num_local_experts") or hf.get("num_experts") or 0
     return ModelConfig(
         name=name,
         vocab_size=hf["vocab_size"],
@@ -196,5 +301,10 @@ def from_hf_config(hf: Dict[str, Any], name: str = "hf-model") -> ModelConfig:
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         sliding_window=hf.get("sliding_window"),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
-        qk_norm=hf.get("model_type", "") == "qwen3",
+        qk_norm=hf.get("model_type", "") in ("qwen3", "qwen3_moe"),
+        num_experts=num_experts,
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        moe_intermediate_size=hf.get("moe_intermediate_size"),
+        # mixtral always renormalizes the top-k weights; qwen3_moe gates it
+        norm_topk_prob=hf.get("norm_topk_prob", True),
     )

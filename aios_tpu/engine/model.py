@@ -41,8 +41,12 @@ from .config import ModelConfig
 
 Params = Dict[str, jnp.ndarray]
 
-# weights that get the int8 serving treatment (contraction dim is axis -2)
-QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# weights that get the int8 serving treatment (contraction dim is axis -2);
+# we_* are the expert-stacked MoE leaves (the router stays bf16 — tiny)
+QUANT_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "we_gate", "we_up", "we_down",
+)
 
 
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
@@ -106,17 +110,18 @@ def quantize_params(
         for k, v in src.items()
         if k not in QUANT_KEYS
     }
+    moe = "w_router" in src
     if fuse:
         qkv = jnp.concatenate([src["wq"], src["wk"], src["wv"]], axis=-1)
-        gateup = jnp.concatenate([src["w_gate"], src["w_up"]], axis=-1)
-        to_quant = (
-            ("w_qkv", qkv),
-            ("wo", src["wo"]),
-            ("w_gateup", gateup),
-            ("w_down", src["w_down"]),
-        )
+        to_quant = (("w_qkv", qkv), ("wo", src["wo"]))
+        if moe:
+            gateup = jnp.concatenate([src["we_gate"], src["we_up"]], axis=-1)
+            to_quant += (("we_gateup", gateup), ("we_down", src["we_down"]))
+        else:
+            gateup = jnp.concatenate([src["w_gate"], src["w_up"]], axis=-1)
+            to_quant += (("w_gateup", gateup), ("w_down", src["w_down"]))
     else:
-        to_quant = tuple((k, src[k]) for k in QUANT_KEYS)
+        to_quant = tuple((k, src[k]) for k in QUANT_KEYS if k in src)
     for key, w in to_quant:
         q, s = ops.quantize_int8(w, axis=-2)
         layers[key] = {"q": q, "s": s}
@@ -287,8 +292,10 @@ def _project_qkv(x, lp, cfg: ModelConfig, cos, sin):
     return q, k, v
 
 
-def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None):
-    """One transformer block on [B, T, E]; returns (x', (k, v)).
+def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None,
+                with_aux: bool = False):
+    """One transformer block on [B, T, E]; returns (x', (k, v)) — or
+    (x', (k, v, moe_aux)) when ``with_aux``.
 
     The single source of truth for block structure — the prefill/training
     forward, the decode step, and the pipeline-parallel stage all build on
@@ -299,12 +306,39 @@ def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None):
     q, k, v = _project_qkv(x, lp, cfg, cos, sin)
     attn = attention(q, k, v, mask)
     x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-    x = x + _mlp(x, lp, cfg)
+    mlp_out, aux = _mlp_aux(x, lp, cfg, allow_dispatch=with_aux)
+    x = x + mlp_out
+    if with_aux:
+        return x, (k, v, aux)
     return x, (k, v)
 
 
 def _mlp(x, lp, cfg: ModelConfig):
+    return _mlp_aux(x, lp, cfg)[0]
+
+
+def _mlp_aux(x, lp, cfg: ModelConfig, allow_dispatch: bool = False):
+    """FFN sublayer; returns (out, moe_aux) — aux is the router
+    load-balancing term (0.0 for dense models), consumed only by the
+    training forward (forward_full with_aux=True)."""
     h = rms_norm(x, lp["ffn_norm"], cfg.rms_norm_eps)
+    if "w_router" in lp:  # mixture-of-experts FFN (engine/moe.py)
+        import os
+
+        from . import moe as moe_mod
+
+        impl = os.environ.get("AIOS_TPU_MOE_IMPL", "auto")
+        n_tok = h.shape[0] * h.shape[1]
+        # The capacity-based dispatch path may DROP overflow picks, so auto
+        # only selects it on the training forward (``allow_dispatch``, i.e.
+        # with_aux) at large token counts — every serving path (decode,
+        # chunked/bucketed prefill) stays on the exact dense path unless
+        # the env explicitly forces dispatch.
+        if impl == "dispatch" or (
+            impl == "auto" and allow_dispatch and n_tok >= 1024
+        ):
+            return moe_mod.moe_ffn_dispatch(h, lp, cfg)
+        return moe_mod.moe_ffn_dense(h, lp, cfg)
     if "w_gateup" in lp:  # fused serving layout (quantize_params)
         F = cfg.intermediate_size
         gu = matmul(h, lp["w_gateup"])
@@ -313,7 +347,7 @@ def _mlp(x, lp, cfg: ModelConfig):
         gate_pre = matmul(h, lp["w_gate"])
         up = matmul(h, lp["w_up"])
     gate = jax.nn.silu(gate_pre.astype(jnp.float32)).astype(h.dtype)
-    return matmul(gate * up, lp["w_down"])
+    return matmul(gate * up, lp["w_down"]), jnp.float32(0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +361,7 @@ def forward_full(
     tokens: jnp.ndarray,
     attn_fn=None,
     kernels: Optional[bool] = None,
+    with_aux: bool = False,
 ) -> jnp.ndarray:
     """Full-sequence causal forward; logits [B, T, V] in fp32.
 
@@ -335,7 +370,14 @@ def forward_full(
     sequence-parallel training); it defaults to in-core GQA attention.
     ``kernels=False`` forces the pure-XLA path — required under autodiff:
     the Pallas flash kernel is forward-only (no VJP rule yet).
+    ``with_aux`` additionally returns the mean per-layer MoE
+    load-balancing loss (0.0 for dense models): (logits, aux).
     """
+    if with_aux:
+        logits, _, _, aux = _forward_with_kv(
+            params, cfg, tokens, attn_fn, kernels, with_aux=True
+        )
+        return logits, aux
     logits, _, _ = _forward_with_kv(params, cfg, tokens, attn_fn, kernels)
     return logits
 
@@ -394,7 +436,8 @@ def _use_ragged_kernel(
     )
 
 
-def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=None):
+def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=None,
+                     with_aux: bool = False):
     B, T = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
@@ -414,8 +457,12 @@ def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=Non
     mask = causal_mask(T, cfg.sliding_window)
 
     def block(x, lp):
-        return apply_block(x, lp, cfg, cos, sin, mask, attention)
+        return apply_block(x, lp, cfg, cos, sin, mask, attention, with_aux)
 
+    if with_aux:
+        x, (ks, vs, auxs) = jax.lax.scan(block, x, params["layers"])
+        logits = _final_logits(x, params, cfg)
+        return logits, ks, vs, jnp.mean(auxs)
     x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
     logits = _final_logits(x, params, cfg)
     return logits, ks, vs
@@ -1104,10 +1151,17 @@ def init_params(
         "wk": normal((L, E, cfg.kv_dim)),
         "wv": normal((L, E, cfg.kv_dim)),
         "wo": normal((L, cfg.q_dim, E)),
-        "w_gate": normal((L, E, F)),
-        "w_up": normal((L, E, F)),
-        "w_down": normal((L, F, E)),
     }
+    if cfg.moe:
+        X, Fm = cfg.num_experts, cfg.expert_dim
+        layers["w_router"] = normal((L, E, X))
+        layers["we_gate"] = normal((L, X, E, Fm))
+        layers["we_up"] = normal((L, X, E, Fm))
+        layers["we_down"] = normal((L, X, Fm, E))
+    else:
+        layers["w_gate"] = normal((L, E, F))
+        layers["w_up"] = normal((L, E, F))
+        layers["w_down"] = normal((L, F, E))
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((L, D), dtype)
         layers["k_norm"] = jnp.ones((L, D), dtype)
@@ -1154,13 +1208,27 @@ def init_quantized_params(
     if fuse:
         layers["w_qkv"] = qleaf((L, E, cfg.q_dim + 2 * cfg.kv_dim))
         layers["wo"] = qleaf((L, cfg.q_dim, E))
-        layers["w_gateup"] = qleaf((L, E, 2 * F))
-        layers["w_down"] = qleaf((L, F, E))
     else:
         layers["wq"] = qleaf((L, E, cfg.q_dim))
         layers["wk"] = qleaf((L, E, cfg.kv_dim))
         layers["wv"] = qleaf((L, E, cfg.kv_dim))
         layers["wo"] = qleaf((L, cfg.q_dim, E))
+    if cfg.moe:
+        X, Fm = cfg.num_experts, cfg.expert_dim
+        layers["w_router"] = (
+            jax.random.normal(next(keys), (L, E, X), jnp.float32) * 0.02
+        ).astype(dtype)
+        if fuse:
+            layers["we_gateup"] = qleaf((L, X, E, 2 * Fm))
+            layers["we_down"] = qleaf((L, X, Fm, E))
+        else:
+            layers["we_gate"] = qleaf((L, X, E, Fm))
+            layers["we_up"] = qleaf((L, X, E, Fm))
+            layers["we_down"] = qleaf((L, X, Fm, E))
+    elif fuse:
+        layers["w_gateup"] = qleaf((L, E, 2 * F))
+        layers["w_down"] = qleaf((L, F, E))
+    else:
         layers["w_gate"] = qleaf((L, E, F))
         layers["w_up"] = qleaf((L, E, F))
         layers["w_down"] = qleaf((L, F, E))

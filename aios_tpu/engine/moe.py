@@ -1,0 +1,191 @@
+"""Mixture-of-experts FFN: router + expert computation, TPU-first.
+
+Two implementations of the same math (top-k routed SwiGLU experts):
+
+  * ``moe_ffn_dense`` — every expert processes every token; per-token gate
+    weights (zero for unselected experts) scale the outputs. Exact and
+    dropless. Decode steps are weight-bandwidth-bound, and at serving batch
+    sizes the routed set spans most experts anyway, so streaming all expert
+    weights is the honest cost — this is the serving path. The einsum
+    contracts over the expert axis, so under expert parallelism (experts
+    sharded on the mesh's ``ep`` axis) each device computes its local
+    experts and XLA inserts one psum over ``ep`` — no hand-written
+    collectives, same GSPMD recipe as the Megatron TP rules
+    (parallel/sharding.py).
+  * ``moe_ffn_dispatch`` — GShard-style capacity-based dispatch/combine
+    one-hot einsums: tokens route to per-expert queues of ``capacity``
+    slots, experts run a batched SwiGLU over their queues, outputs combine
+    back weighted by the gates. FLOPs scale with k/num_experts instead of
+    num_experts — the training/prefill path at large token counts. Tokens
+    beyond an expert's capacity are dropped (their contribution from that
+    expert is zero), the standard training trade; with generous capacity
+    the result is bit-identical to the dense path (tested).
+
+Replaces: nothing in the reference — its only MoE access is the cloud
+qwen3:30b endpoint behind the api-gateway (api-gateway/src/main.rs:70-88).
+Serving the Qwen3-30B-A3B tier locally is a TPU-build extension.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+
+def _expert_einsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """einsum where w may be a dense array or an int8 leaf {"q", "s"}.
+
+    Quantized expert leaves keep per-output-channel scales on a size-1
+    contraction axis (model.quantize_params, axis=-2), so scaling the
+    einsum output by a broadcast of ``s`` reproduces the dequantized
+    result — the expert-stacked twin of model.matmul. The spec's output
+    must keep the expert axis leading (``x...``): the scales are
+    per-(expert, out-channel), so they can only be applied before any
+    reduction over experts.
+    """
+    if isinstance(w, dict):
+        w_q, s = w["q"], w["s"]
+        assert spec.split("->")[1][0] == "x", spec
+        y = jnp.einsum(
+            spec, x, w_q, preferred_element_type=jnp.float32
+        )
+        # s [X, 1, out] -> [X, 1, out] broadcasting over the token/queue axis
+        return (y * jnp.squeeze(s, axis=-2)[:, None, :]).astype(x.dtype)
+    return jnp.einsum(spec, x, w)
+
+
+def route(
+    h: jnp.ndarray,  # [N, E] normalized hidden states
+    w_router,  # [E, X]
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k routing. Returns (probs [N, X] fp32, weights [N, k] fp32,
+    idx [N, k] int32). ``probs`` is the full softmax (for the
+    load-balancing aux loss); ``weights`` are the selected gates,
+    renormalized over the top-k set when cfg.norm_topk_prob (the
+    Mixtral/Qwen3-MoE convention)."""
+    if isinstance(w_router, dict):  # never quantized, but be safe
+        w_router = w_router["q"].astype(jnp.float32) * w_router["s"]
+    logits = (h.astype(jnp.float32) @ w_router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    if cfg.norm_topk_prob:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return probs, weights, idx.astype(jnp.int32)
+
+
+def gate_matrix(
+    weights: jnp.ndarray, idx: jnp.ndarray, num_experts: int
+) -> jnp.ndarray:
+    """Scatter top-k (weights, idx) into a full [N, X] gate matrix."""
+    onehot = jax.nn.one_hot(idx, num_experts, dtype=weights.dtype)  # [N,k,X]
+    return jnp.einsum("nk,nkx->nx", weights, onehot)
+
+
+def load_balance_aux(
+    probs: jnp.ndarray, idx: jnp.ndarray, num_experts: int
+) -> jnp.ndarray:
+    """Switch-transformer load-balancing loss for one layer:
+    X * sum_x(fraction_of_tokens_routed_to_x * mean_router_prob_x).
+    Equals 1.0 under perfect balance; minimized jointly with the LM loss
+    (train.py weights it by moe_aux_coef)."""
+    X = num_experts
+    counts = jnp.sum(
+        jax.nn.one_hot(idx, X, dtype=jnp.float32), axis=(0, 1)
+    )  # [X] — how many (token, slot) picks landed on each expert
+    frac = counts / jnp.maximum(jnp.sum(counts), 1.0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return X * jnp.sum(frac * mean_prob)
+
+
+def moe_ffn_dense(
+    h: jnp.ndarray,  # [B, T, E] normalized hidden states
+    lp,  # layer params holding w_router / we_gate / we_up / we_down
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact dropless MoE FFN; returns (out [B, T, E], aux scalar fp32)."""
+    B, T, E = h.shape
+    flat = h.reshape(B * T, E)
+    probs, weights, idx = route(flat, lp["w_router"], cfg)
+    gates = gate_matrix(weights, idx, cfg.num_experts).astype(h.dtype)  # [N,X]
+
+    if "we_gateup" in lp:  # fused serving layout (model.quantize_params)
+        F = cfg.expert_dim
+        gu = _expert_einsum("ne,xef->xnf", flat, lp["we_gateup"])
+        g, u = gu[..., :F], gu[..., F:]
+    else:
+        g = _expert_einsum("ne,xef->xnf", flat, lp["we_gate"])
+        u = _expert_einsum("ne,xef->xnf", flat, lp["we_up"])
+    z = jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u  # [X, N, F]
+    z = z * gates.T[..., None]  # gate before down-proj: scales per (x, n)
+    # Down-project then contract the expert axis — one psum over ep under
+    # GSPMD. Quantized leaves need the per-expert scale applied before the
+    # expert reduction, hence the explicit xne intermediate + sum.
+    if isinstance(lp["we_down"], dict):
+        y = _expert_einsum("xnf,xfe->xne", z, lp["we_down"])
+        out = jnp.sum(y.astype(jnp.float32), axis=0).astype(h.dtype)
+    else:
+        out = jnp.einsum("xnf,xfe->ne", z, lp["we_down"])
+    aux = load_balance_aux(probs, idx, cfg.num_experts)
+    return out.reshape(B, T, E), aux
+
+
+def moe_ffn_dispatch(
+    h: jnp.ndarray,  # [B, T, E] normalized hidden states
+    lp,
+    cfg: ModelConfig,
+    capacity_factor: float = 1.25,
+    capacity: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Capacity-based GShard dispatch MoE FFN; returns (out, aux).
+
+    ``capacity`` (per-expert queue length) defaults to
+    ceil(N * k / X * capacity_factor) rounded up to a multiple of 8 —
+    static, so the jit graph is fixed-shape regardless of routing.
+    """
+    B, T, E = h.shape
+    N = B * T
+    X, k = cfg.num_experts, cfg.num_experts_per_tok
+    flat = h.reshape(N, E)
+    probs, weights, idx = route(flat, lp["w_router"], cfg)
+
+    if capacity is None:
+        capacity = max(8, int(-(-N * k * capacity_factor // X)))
+        capacity = min(-(-capacity // 8) * 8, N * k)
+
+    # Queue position of each (token, slot) pick within its expert, in
+    # (token-major, slot-minor) priority order: a running count of prior
+    # picks of the same expert.
+    onehot = jax.nn.one_hot(idx, X, dtype=jnp.int32).reshape(N * k, X)
+    pos = (jnp.cumsum(onehot, axis=0) - onehot)  # picks before this one
+    pos = jnp.sum(pos * onehot, axis=-1).reshape(N, k)  # [N, k]
+    keep = pos < capacity  # dropped picks contribute zero
+
+    # dispatch [N, k, X, cap] collapses to bool [N, X, cap]; combine is the
+    # same structure carrying the gate weights.
+    slot_oh = jax.nn.one_hot(
+        jnp.where(keep, pos, capacity), capacity, dtype=h.dtype
+    )  # [N, k, cap] — overflow rows one-hot off the end -> all-zero
+    exp_oh = jax.nn.one_hot(idx, X, dtype=h.dtype)  # [N, k, X]
+    combine = jnp.einsum(
+        "nk,nkx,nkc->nxc", weights.astype(h.dtype), exp_oh, slot_oh
+    )
+    dispatch = jnp.einsum("nkx,nkc->nxc", exp_oh, slot_oh)
+
+    xe = jnp.einsum("nxc,ne->xce", dispatch, flat)  # [X, cap, E]
+    if "we_gateup" in lp:
+        F = cfg.expert_dim
+        gu = _expert_einsum("xce,xef->xcf", xe, lp["we_gateup"])
+        g, u = gu[..., :F], gu[..., F:]
+    else:
+        g = _expert_einsum("xce,xef->xcf", xe, lp["we_gate"])
+        u = _expert_einsum("xce,xef->xcf", xe, lp["we_up"])
+    z = jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u
+    ye = _expert_einsum("xcf,xfe->xce", z, lp["we_down"])
+    out = jnp.einsum("nxc,xce->ne", combine, ye)
+    aux = load_balance_aux(probs, idx, X)
+    return out.reshape(B, T, E), aux

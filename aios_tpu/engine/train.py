@@ -62,6 +62,7 @@ def make_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     remat: bool = True,
     seq_parallel: str = "ring",
+    moe_aux_coef: float = 0.01,
 ) -> Tuple[Callable, Callable]:
     """Returns (init_state, train_step), both jittable.
 
@@ -91,16 +92,20 @@ def make_train_step(
     # take the differentiable XLA attention (or the explicit ring attn_fn)
     forward = model.forward_full
     if remat:
-        forward = jax.checkpoint(forward, static_argnums=(1, 3, 4))
+        forward = jax.checkpoint(forward, static_argnums=(1, 3, 4, 5))
 
     def loss_fn(params, tokens, loss_mask):
         if mesh is not None:
             tokens = jax.lax.with_sharding_constraint(
                 tokens, NamedSharding(mesh, P("dp", "sp"))
             )
-        logits = forward(params, cfg, tokens, attn_fn, False)  # [B, T, V]
+        if cfg.moe:
+            logits, aux = forward(params, cfg, tokens, attn_fn, False, True)
+        else:
+            logits = forward(params, cfg, tokens, attn_fn, False, False)
+            aux = jnp.float32(0.0)
         nll, denom = token_cross_entropy(logits, tokens, loss_mask)
-        return nll / jnp.maximum(denom, 1.0)
+        return nll / jnp.maximum(denom, 1.0) + moe_aux_coef * aux, aux
 
     def init_state(params) -> TrainState:
         return {
@@ -110,7 +115,7 @@ def make_train_step(
         }
 
     def train_step(state: TrainState, batch: Dict) -> Tuple[TrainState, Dict]:
-        loss, grads = jax.value_and_grad(loss_fn)(
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch["tokens"], batch["loss_mask"]
         )
         updates, opt_state = optimizer.update(
@@ -123,7 +128,7 @@ def make_train_step(
             "step": state["step"] + 1,
         }
         gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        return new_state, {"loss": loss, "grad_norm": gnorm, "moe_aux": aux}
 
     return init_state, train_step
 

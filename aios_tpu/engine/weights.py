@@ -72,10 +72,18 @@ def params_from_gguf(
             "wk": wk.T,
             "wv": t(p + "attn_v.weight").T,
             "wo": t(p + "attn_output.weight").T,
-            "w_gate": t(p + "ffn_gate.weight").T,
-            "w_up": t(p + "ffn_up.weight").T,
-            "w_down": t(p + "ffn_down.weight").T,
         }
+        if cfg.moe:
+            # expert-stacked tensors load as [X, out, in] (row-major of the
+            # GGML innermost-first dims); engine layout is [X, in, out]
+            layer["w_router"] = t(p + "ffn_gate_inp.weight").T
+            layer["we_gate"] = t(p + "ffn_gate_exps.weight").swapaxes(-1, -2)
+            layer["we_up"] = t(p + "ffn_up_exps.weight").swapaxes(-1, -2)
+            layer["we_down"] = t(p + "ffn_down_exps.weight").swapaxes(-1, -2)
+        else:
+            layer["w_gate"] = t(p + "ffn_gate.weight").T
+            layer["w_up"] = t(p + "ffn_up.weight").T
+            layer["w_down"] = t(p + "ffn_down.weight").T
         if cfg.qk_norm:
             layer["q_norm"] = t(p + "attn_q_norm.weight")
             layer["k_norm"] = t(p + "attn_k_norm.weight")
@@ -120,10 +128,35 @@ def params_from_hf_state_dict(
             "wk": get(p + "self_attn.k_proj.weight").T,
             "wv": get(p + "self_attn.v_proj.weight").T,
             "wo": get(p + "self_attn.o_proj.weight").T,
-            "w_gate": get(p + "mlp.gate_proj.weight").T,
-            "w_up": get(p + "mlp.up_proj.weight").T,
-            "w_down": get(p + "mlp.down_proj.weight").T,
         }
+        if cfg.moe:
+            # qwen3_moe: mlp.gate + mlp.experts.N.{gate,up,down}_proj
+            # mixtral: block_sparse_moe.gate + experts.N.{w1,w3,w2}
+            if p + "mlp.gate.weight" in sd:
+                m, eg, eu, ed = (
+                    "mlp.gate", "gate_proj", "up_proj", "down_proj",
+                )
+                ep_ = "mlp.experts."
+            else:
+                m, eg, eu, ed = ("block_sparse_moe.gate", "w1", "w3", "w2")
+                ep_ = "block_sparse_moe.experts."
+            layer["w_router"] = get(f"{p}{m}.weight").T
+            layer["we_gate"] = np.stack([
+                get(f"{p}{ep_}{j}.{eg}.weight").T
+                for j in range(cfg.num_experts)
+            ])
+            layer["we_up"] = np.stack([
+                get(f"{p}{ep_}{j}.{eu}.weight").T
+                for j in range(cfg.num_experts)
+            ])
+            layer["we_down"] = np.stack([
+                get(f"{p}{ep_}{j}.{ed}.weight").T
+                for j in range(cfg.num_experts)
+            ])
+        else:
+            layer["w_gate"] = get(p + "mlp.gate_proj.weight").T
+            layer["w_up"] = get(p + "mlp.up_proj.weight").T
+            layer["w_down"] = get(p + "mlp.down_proj.weight").T
         if cfg.qk_norm:
             layer["q_norm"] = get(p + "self_attn.q_norm.weight")
             layer["k_norm"] = get(p + "self_attn.k_norm.weight")

@@ -103,7 +103,7 @@ def initialize_from_env() -> bool:
 
 
 def build_global_mesh(dp: int = 0, sp: int = 1, tp: int = 1):
-    """A ("dp", "sp", "tp") mesh over EVERY process's devices, laid out so
+    """A ("dp", "sp", "ep", "tp") mesh over EVERY process's devices, so
     dp's outer factor spans hosts (DCN) and sp/tp stay within a host
     (ICI). dp=0 means "whatever is left". The result drops straight into
     the existing ShardingPlan / train / TP-decode stack — multi-host scale
@@ -134,7 +134,10 @@ def build_global_mesh(dp: int = 0, sp: int = 1, tp: int = 1):
             devs = mesh_utils.create_hybrid_device_mesh(
                 (local_dp, sp, tp), (n_proc, 1, 1)
             )
-            return Mesh(devs, ("dp", "sp", "tp"))
+            return Mesh(
+                devs.reshape(n_proc * local_dp, sp, 1, tp),
+                ("dp", "sp", "ep", "tp"),
+            )
         except Exception as e:  # noqa: BLE001 — CPU backends lack topology
             log.debug("hybrid mesh unavailable (%s); process-sorted grid", e)
         # group by process explicitly: devices sorted (process, local) so
@@ -142,10 +145,10 @@ def build_global_mesh(dp: int = 0, sp: int = 1, tp: int = 1):
         devs = sorted(
             jax.devices(), key=lambda d: (d.process_index, d.id)
         )
-        grid = np.array(devs).reshape(n_proc * local_dp, sp, tp)
-        return Mesh(grid, ("dp", "sp", "tp"))
-    grid = np.array(jax.devices()[:total]).reshape(local_dp, sp, tp)
-    return Mesh(grid, ("dp", "sp", "tp"))
+        grid = np.array(devs).reshape(n_proc * local_dp, sp, 1, tp)
+        return Mesh(grid, ("dp", "sp", "ep", "tp"))
+    grid = np.array(jax.devices()[:total]).reshape(local_dp, sp, 1, tp)
+    return Mesh(grid, ("dp", "sp", "ep", "tp"))
 
 
 def cross_host_allreduce_check(mesh) -> float:

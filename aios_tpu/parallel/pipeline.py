@@ -78,12 +78,15 @@ def make_pp_train_step(
     num_microbatches: int,
     optimizer: Optional[optax.GradientTransformation] = None,
     remat: bool = True,
+    moe_aux_coef: float = 0.01,
 ) -> Tuple[Callable, Callable]:
     """Returns (init_state, train_step) for pipeline-parallel training.
 
     Batches are {"tokens": [B, T], "loss_mask": [B, T]} with
     B % (num_microbatches * dp) == 0; the step reshapes to
-    [MB, mb, T] microbatches internally.
+    [MB, mb, T] microbatches internally. MoE configs fold the router
+    load-balancing aux (weighted by ``moe_aux_coef``) into the loss, same
+    contract as the GSPMD train step (engine/train.py).
     """
     from ..engine.train import make_optimizer
 
@@ -95,19 +98,22 @@ def make_pp_train_step(
     )
 
     def stage_apply(layers_local, x):
-        """Run this stage's layer block on activations x [mb, T, E]."""
+        """Run this stage's layer block on activations x [mb, T, E];
+        returns (x', stage aux sum over local layers)."""
         mb, T, _ = x.shape
         positions = jnp.broadcast_to(jnp.arange(T), (mb, T))
         cos, sin = model.rope_tables(positions, cfg.head_dim, cfg.rope_theta)
         mask = model.causal_mask(T, cfg.sliding_window)
 
         def blk(x, lp):
-            x, _ = model.apply_block(x, lp, cfg, cos, sin, mask)
-            return x, None
+            x, (_, _, aux) = model.apply_block(
+                x, lp, cfg, cos, sin, mask, with_aux=True
+            )
+            return x, aux
 
         blk_fn = jax.checkpoint(blk) if remat else blk
-        x, _ = jax.lax.scan(blk_fn, x, layers_local)
-        return x
+        x, auxs = jax.lax.scan(blk_fn, x, layers_local)
+        return x, jnp.sum(auxs)
 
     def pp_loss(params, tokens_mb, mask_mb):
         """Inside shard_map: tokens_mb [MB, mb_local, T] per device."""
@@ -132,11 +138,15 @@ def make_pp_train_step(
             )
 
         def tick(carry, t):
-            x_in, loss_acc, denom_acc = carry
+            x_in, loss_acc, denom_acc, aux_acc = carry
             in_idx = jnp.clip(t, 0, MB - 1)
             fresh = embed[tokens_mb[in_idx]].astype(x_in.dtype)  # [mb, T, E]
             x = jnp.where(s == 0, fresh, x_in)
-            y = stage_apply(layers_local, x)
+            y, aux_t = stage_apply(layers_local, x)
+            # stage s holds microbatch t-s at tick t; bubble ticks run the
+            # router on garbage activations, so their aux must not count
+            holds_mb = jnp.logical_and(t - s >= 0, t - s < MB)
+            aux_acc = aux_acc + jnp.where(holds_mb, aux_t, 0.0)
 
             out_idx = t - (S - 1)
             is_producer = jnp.logical_and(
@@ -148,17 +158,23 @@ def make_pp_train_step(
                 lambda: (jnp.float32(0.0), jnp.float32(0.0)),
             )
             x_next = jax.lax.ppermute(y, "pp", perm)
-            return (x_next, loss_acc + dl, denom_acc + dd), None
+            return (x_next, loss_acc + dl, denom_acc + dd, aux_acc), None
 
         x0 = jnp.zeros((mb, T, E), embed.dtype)
-        (_, loss_sum, denom), _ = jax.lax.scan(
+        (_, loss_sum, denom, aux_sum), _ = jax.lax.scan(
             tick,
-            (x0, jnp.float32(0.0), jnp.float32(0.0)),
+            (x0, jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0)),
             jnp.arange(MB + S - 1),
         )
         loss_sum = jax.lax.psum(loss_sum, ("pp", "dp"))
         denom = jax.lax.psum(denom, ("pp", "dp"))
-        return loss_sum / jnp.maximum(denom, 1.0)
+        # sum over (stages x valid ticks x local layers) = layers x MB,
+        # summed again over dp shards -> mean per (layer, microbatch, shard)
+        aux_sum = jax.lax.psum(aux_sum, ("pp", "dp"))
+        aux_mean = aux_sum / jnp.float32(
+            cfg.num_layers * MB * mesh.shape["dp"]
+        )
+        return loss_sum / jnp.maximum(denom, 1.0), aux_mean
 
     def loss_fn(params, tokens, loss_mask):
         B, T = tokens.shape
@@ -179,10 +195,11 @@ def make_pp_train_step(
                 P(None, "dp", None),
                 P(None, "dp", None),
             ),
-            out_specs=P(),
+            out_specs=(P(), P()),
             check_rep=False,
         )
-        return sharded(pp_loss)(params, tokens_mb, mask_mb)
+        ce, aux = sharded(pp_loss)(params, tokens_mb, mask_mb)
+        return ce + moe_aux_coef * aux, aux
 
     def init_state(params):
         return {
@@ -192,7 +209,7 @@ def make_pp_train_step(
         }
 
     def train_step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch["tokens"], batch["loss_mask"]
         )
         updates, opt_state = optimizer.update(
@@ -204,6 +221,10 @@ def make_pp_train_step(
             "opt_state": opt_state,
             "step": state["step"] + 1,
         }
-        return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        return new_state, {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "moe_aux": aux,
+        }
 
     return init_state, train_step

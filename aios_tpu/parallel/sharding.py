@@ -9,7 +9,12 @@ scaling-book recipe: pick a mesh, annotate, let XLA do the rest.
 Axes:
   dp — data/replica axis: batch slots in decode, batch in training
   sp — sequence axis: ring-attention sequence parallelism (long context)
+  ep — expert axis: MoE experts sharded across chips (engine/moe.py); the
+       dense-MoE einsum contracts the expert axis, so GSPMD inserts one
+       psum over ep per MoE layer — expert parallelism with no explicit
+       dispatch collectives
   tp — model axis: attention heads + FFN hidden sharded across chips
+       (innermost: the per-matmul allreduce rides the fastest ICI links)
 
 Equivalent role in the reference: none (single-process llama.cpp); this is
 the "Mistral-7B tensor-parallel decode across 4 chips (ICI all-reduce)"
@@ -33,20 +38,21 @@ def build_mesh(
     *,
     dp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     tp: Optional[int] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a (dp, sp, tp) mesh. Unspecified tp absorbs remaining devices."""
+    """Build a (dp, sp, ep, tp) mesh. Unspecified tp absorbs the rest."""
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
     if tp is None:
-        assert n % (dp * sp) == 0, (n, dp, sp)
-        tp = n // (dp * sp)
-    assert dp * sp * tp == n, f"mesh {dp}x{sp}x{tp} != {n} devices"
-    arr = np.asarray(devices).reshape(dp, sp, tp)
-    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+        assert n % (dp * sp * ep) == 0, (n, dp, sp, ep)
+        tp = n // (dp * sp * ep)
+    assert dp * sp * ep * tp == n, f"mesh {dp}x{sp}x{ep}x{tp} != {n} devices"
+    arr = np.asarray(devices).reshape(dp, sp, ep, tp)
+    return Mesh(arr, axis_names=("dp", "sp", "ep", "tp"))
 
 
 # Partition rules for the engine params pytree (path suffix -> spec).
@@ -65,6 +71,13 @@ PARAM_RULES: Dict[str, P] = {
     "layers/w_gate": P(None, None, "tp"),
     "layers/w_up": P(None, None, "tp"),
     "layers/w_down": P(None, "tp", None),
+    # MoE leaves [L, X, in, out]: experts over ep, expert-FFN hidden over tp
+    # (the router is tiny and stays replicated)
+    "layers/w_router": P(None, None, None),
+    "layers/we_gate": P(None, "ep", None, "tp"),
+    "layers/we_up": P(None, "ep", None, "tp"),
+    "layers/we_gateup": P(None, "ep", None, "tp"),
+    "layers/we_down": P(None, "ep", "tp", None),
     "final_norm": P(None),
     "lm_head": P(None, "tp"),
 }
@@ -173,13 +186,24 @@ class ShardingPlan:
     def sp(self) -> int:
         return self.mesh.shape["sp"]
 
+    @property
+    def ep(self) -> int:
+        return self.mesh.shape.get("ep", 1)
+
     def validate(self, cfg: ModelConfig, num_slots: int) -> None:
-        tp, dp = self.tp, self.dp
+        tp, dp, ep = self.tp, self.dp, self.ep
         assert cfg.num_kv_heads % tp == 0, (
             f"kv heads {cfg.num_kv_heads} not divisible by tp={tp}"
         )
         assert cfg.num_heads % tp == 0
-        assert cfg.intermediate_size % tp == 0
+        if cfg.moe:
+            assert cfg.num_experts % ep == 0, (
+                f"experts {cfg.num_experts} not divisible by ep={ep}"
+            )
+            assert cfg.expert_dim % tp == 0
+        else:
+            assert ep == 1, "ep>1 requires a MoE config"
+            assert cfg.intermediate_size % tp == 0
         assert num_slots % dp == 0, f"slots {num_slots} not divisible by dp={dp}"
 
 

@@ -35,7 +35,13 @@ from ..engine import gguf as gguf_mod
 from ..engine import model as model_mod
 from ..engine import weights as weights_mod
 from ..engine.batching import ContinuousBatcher
-from ..engine.config import PRESETS, ModelConfig, from_gguf_metadata, TINY_TEST
+from ..engine.config import (
+    PRESETS,
+    ModelConfig,
+    from_gguf_metadata,
+    TINY_MOE,
+    TINY_TEST,
+)
 from ..engine.engine import TPUEngine
 from ..engine.tokenizer import (
     BaseTokenizer,
@@ -320,6 +326,10 @@ class ModelManager:
         low = name.lower()
         if low in ("tiny-test", "tiny"):
             return TINY_TEST
+        if low == "tiny-moe":
+            return TINY_MOE
+        if low in PRESETS:  # exact name wins before any fuzzy match
+            return PRESETS[low]
         for key, cfg in PRESETS.items():
             if low in key or key in low or key.split("-")[0] in low:
                 return cfg

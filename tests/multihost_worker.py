@@ -38,7 +38,7 @@ def main() -> int:
 
     mesh = multihost.build_global_mesh(sp=1, tp=2)
     local_dp = local // 2
-    assert mesh.shape == {"dp": n * local_dp, "sp": 1, "tp": 2}, mesh.shape
+    assert mesh.shape == {"dp": n * local_dp, "sp": 1, "ep": 1, "tp": 2}, mesh.shape
     # every host must see the same global sum: sum over ranks of
     # (rank+1) * local_dp
     total = multihost.cross_host_allreduce_check(mesh)

@@ -24,9 +24,9 @@ def tiny_params():
 
 def test_mesh_construction(cpu_devices):
     mesh = build_mesh(8, dp=2, sp=2)
-    assert mesh.shape == {"dp": 2, "sp": 2, "tp": 2}
+    assert mesh.shape == {"dp": 2, "sp": 2, "ep": 1, "tp": 2}
     mesh2 = build_mesh(4, dp=2)
-    assert mesh2.shape == {"dp": 2, "sp": 1, "tp": 2}
+    assert mesh2.shape == {"dp": 2, "sp": 1, "ep": 1, "tp": 2}
 
 
 def test_plan_validation(cpu_devices):
