@@ -77,6 +77,19 @@ class TPUEngine:
         # EXCEPT decode attention, which is head/slot-local and runs the
         # ragged kernel per device under shard_map (see _attn_impl below).
         self._kernels: Optional[bool] = False if shardings is not None else None
+        # MoE decode: when every slot's picks together touch fewer experts
+        # than exist, the gathered path streams only the routed experts'
+        # weights (moe.moe_ffn_gather — up to X/(slots*k) less FFN HBM
+        # traffic). Single-device only: under EP the expert axis is sharded
+        # and the dense path's psum is the right collective. Decode/verify
+        # dispatches only — prefill token counts saturate the experts.
+        self._moe_impl: Optional[str] = None
+        if (
+            cfg.moe
+            and shardings is None
+            and num_slots * cfg.num_experts_per_tok < cfg.num_experts
+        ):
+            self._moe_impl = "gather"
 
         if shardings is not None:
             if quantize:
@@ -251,6 +264,7 @@ class TPUEngine:
                     kernels=self._kernels,
                     cache_scales=scales,
                     active=st["active"],
+                    moe_impl=self._moe_impl,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out
@@ -267,6 +281,7 @@ class TPUEngine:
                     kernels=self._kernels,
                     cache_scales=(st["k_s"], st["v_s"]),
                     active=st["active"],
+                    moe_impl=self._moe_impl,
                 )
             else:
                 logits, k, v = model.decode_step(
@@ -279,6 +294,7 @@ class TPUEngine:
                     kernels=self._kernels,
                     active=st["active"],
                     attn_impl=self._attn_impl,
+                    moe_impl=self._moe_impl,
                 )
             next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
             slots = jnp.arange(self.num_slots)
@@ -323,6 +339,17 @@ class TPUEngine:
         so this is a strict generalization of ``_step_impl``."""
         S, C, K = self.num_slots, self.max_context, draft_len
         slots = jnp.arange(S)
+        # verify feeds K+1 tokens per slot, so the gather-vs-dense traffic
+        # crossover shifts by that factor: gathering S*(K+1)*k expert
+        # blocks (with duplicates re-streamed) must still undercut the
+        # dense path's X blocks, or verify falls back to dense
+        verify_moe_impl = self._moe_impl
+        if (
+            self._moe_impl == "gather"
+            and S * (K + 1) * self.cfg.num_experts_per_tok
+            >= self.cfg.num_experts
+        ):
+            verify_moe_impl = None
 
         def one(st, _):
             drafts, _num = spec.propose_ngram(
@@ -349,6 +376,7 @@ class TPUEngine:
                     tables,
                     cache_scales=scales,
                     active=st["active"],
+                    moe_impl=verify_moe_impl,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out
@@ -366,6 +394,7 @@ class TPUEngine:
                     kernels=self._kernels,
                     cache_scales=scales,
                     active=st["active"],
+                    moe_impl=verify_moe_impl,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out

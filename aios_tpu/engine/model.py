@@ -313,30 +313,47 @@ def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None,
     return x, (k, v)
 
 
-def _mlp(x, lp, cfg: ModelConfig):
-    return _mlp_aux(x, lp, cfg)[0]
+def _mlp(x, lp, cfg: ModelConfig, moe_impl: Optional[str] = None):
+    return _mlp_aux(x, lp, cfg, moe_impl=moe_impl)[0]
 
 
-def _mlp_aux(x, lp, cfg: ModelConfig, allow_dispatch: bool = False):
+def _mlp_aux(
+    x,
+    lp,
+    cfg: ModelConfig,
+    allow_dispatch: bool = False,
+    moe_impl: Optional[str] = None,
+):
     """FFN sublayer; returns (out, moe_aux) — aux is the router
     load-balancing term (0.0 for dense models), consumed only by the
-    training forward (forward_full with_aux=True)."""
+    training forward (forward_full with_aux=True).
+
+    ``moe_impl`` — explicit MoE path ("dense" | "gather" | "dispatch"),
+    normally chosen statically by the engine (TPUEngine picks "gather" for
+    unsharded decode when slots*k < num_experts); None falls back to the
+    AIOS_TPU_MOE_IMPL env var, then auto.
+    """
     h = rms_norm(x, lp["ffn_norm"], cfg.rms_norm_eps)
     if "w_router" in lp:  # mixture-of-experts FFN (engine/moe.py)
         import os
 
         from . import moe as moe_mod
 
-        impl = os.environ.get("AIOS_TPU_MOE_IMPL", "auto")
+        # the env var stays the operator's escape hatch: it overrides the
+        # engine's static choice (e.g. AIOS_TPU_MOE_IMPL=dense to A/B or
+        # disable the gathered decode path)
+        impl = os.environ.get("AIOS_TPU_MOE_IMPL") or moe_impl or "auto"
         n_tok = h.shape[0] * h.shape[1]
-        # The capacity-based dispatch path may DROP overflow picks, so auto
-        # only selects it on the training forward (``allow_dispatch``, i.e.
-        # with_aux) at large token counts — every serving path (decode,
-        # chunked/bucketed prefill) stays on the exact dense path unless
-        # the env explicitly forces dispatch.
-        if impl == "dispatch" or (
-            impl == "auto" and allow_dispatch and n_tok >= 1024
-        ):
+        if impl == "dispatch":
+            return moe_mod.moe_ffn_dispatch(h, lp, cfg)
+        if impl == "gather":
+            return moe_mod.moe_ffn_gather(h, lp, cfg)
+        if impl == "auto" and allow_dispatch and n_tok >= 1024:
+            # The capacity-based dispatch path may DROP overflow picks, so
+            # auto only selects it on the training forward
+            # (``allow_dispatch``, i.e. with_aux) at large token counts —
+            # every serving path (decode, chunked/bucketed prefill) stays
+            # on an exact path unless the env explicitly forces dispatch.
             return moe_mod.moe_ffn_dispatch(h, lp, cfg)
         return moe_mod.moe_ffn_dense(h, lp, cfg)
     if "w_gateup" in lp:  # fused serving layout (quantize_params)
@@ -590,6 +607,7 @@ def decode_step(
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
     attn_impl=None,  # (q [B,H,D], k_l, v_l, lengths) -> [B,H,D]
+    moe_impl: Optional[str] = None,
 ):
     """One batched decode step over the slot cache.
 
@@ -683,7 +701,7 @@ def decode_step(
             else:
                 attn = gqa_attention(q, k_l, v_l, mask)
         x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
+        x = x + _mlp(x, lp, cfg, moe_impl)
         if quant_cache:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -817,6 +835,7 @@ def decode_step_paged(
     kernels: Optional[bool] = None,
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
+    moe_impl: Optional[str] = None,
 ):
     """One batched decode step over the PAGED slot cache.
 
@@ -897,7 +916,7 @@ def decode_step_paged(
                     window=cfg.sliding_window,
                 )[:, None]
         x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
+        x = x + _mlp(x, lp, cfg, moe_impl)
         if quant_pool:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -926,6 +945,7 @@ def verify_step_paged(
     tables: jnp.ndarray,  # [B, MB] int32
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
+    moe_impl: Optional[str] = None,
 ):
     """``verify_step`` over the PAGED cache: the T in-flight rows scatter
     through the page tables (inactive slots -> sacrificial page 0), and
@@ -982,7 +1002,7 @@ def verify_step_paged(
             v_all = v_l[tables].reshape(B, C, *v_l.shape[2:])
         attn = gqa_attention(q, k_all, v_all, mask)
         x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
+        x = x + _mlp(x, lp, cfg, moe_impl)
         if quant_pool:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -1011,6 +1031,7 @@ def verify_step(
     kernels: Optional[bool] = None,
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
+    moe_impl: Optional[str] = None,
 ):
     """Batched multi-token decode for speculative verification.
 
@@ -1107,7 +1128,7 @@ def verify_step(
             else:
                 attn = gqa_attention(q, k_l, v_l, mask)
         x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
+        x = x + _mlp(x, lp, cfg, moe_impl)
         if quant_cache:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)

@@ -134,6 +134,63 @@ def moe_ffn_dense(
     return out.reshape(B, T, E), aux
 
 
+def moe_ffn_gather(
+    h: jnp.ndarray,  # [B, T, E] normalized hidden states
+    lp,
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Gathered-expert MoE FFN for SMALL token counts; returns (out, aux).
+
+    Decode is weight-bandwidth-bound, and with N*k picks below the expert
+    count most experts are idle — so instead of streaming every expert's
+    weights (moe_ffn_dense), gather exactly the N*k routed experts' weight
+    blocks and run one batched per-pick SwiGLU. HBM traffic drops from
+    X * 3EF bytes to N*k * 3EF bytes per layer: ~16x less FFN traffic for
+    a single request on a top-8-of-128 model (qwen3-30b-a3b), ~2x at batch
+    8. Exact and dropless — identical math to the dense path, reordered.
+
+    Single-device layouts only: the weight gather indexes the expert axis,
+    which under expert parallelism is sharded (an ep-sharded gather would
+    bounce picks across chips; the dense path's psum handles that case).
+    """
+    B, T, E = h.shape
+    N = B * T
+    k = cfg.num_experts_per_tok
+    flat = h.reshape(N, E)
+    probs, weights, idx = route(flat, lp["w_router"], cfg)
+    picks = idx.reshape(N * k)  # [P] expert id per pick
+    x_pick = jnp.repeat(flat, k, axis=0)  # [P, E] token repeated per pick
+
+    def pick_einsum(x, w):  # x [P, E or F], w [X, in, out] -> [P, out]
+        if isinstance(w, dict):
+            w_q, s = w["q"], w["s"]  # s [X, 1, out]
+            y = jnp.einsum(
+                "pi,pio->po",
+                x,
+                w_q[picks],
+                preferred_element_type=jnp.float32,
+            )
+            return (y * s[picks, 0, :]).astype(x.dtype)
+        return jnp.einsum("pi,pio->po", x, w[picks])
+
+    if "we_gateup" in lp:  # fused serving layout (quantize_params)
+        F = cfg.expert_dim
+        gu = pick_einsum(x_pick, lp["we_gateup"])
+        g, u = gu[..., :F], gu[..., F:]
+    else:
+        g = pick_einsum(x_pick, lp["we_gate"])
+        u = pick_einsum(x_pick, lp["we_up"])
+    z = jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u  # [P, F]
+    y_pick = pick_einsum(z, lp["we_down"])  # [P, E]
+    out = jnp.sum(
+        y_pick.reshape(N, k, E).astype(jnp.float32)
+        * weights[..., None],
+        axis=1,
+    ).astype(h.dtype)
+    aux = load_balance_aux(probs, idx, cfg.num_experts)
+    return out.reshape(B, T, E), aux
+
+
 def moe_ffn_dispatch(
     h: jnp.ndarray,  # [B, T, E] normalized hidden states
     lp,
