@@ -139,6 +139,200 @@ class SentencePieceBPE(BaseTokenizer):
         return "".join(out).replace(SPIECE_SPACE, " ").lstrip(" ")
 
 
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's byte<->printable-unicode table (every byte gets a visible
+    char so BPE merges operate on strings)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+# pretokenizer split patterns by GGUF `tokenizer.ggml.pre` family; the
+# regex module supports the \p{} classes these need
+_PRE_PATTERNS = {
+    "gpt2": r"""'(?:[sdmt]|ll|ve|re)| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""",
+    "qwen2": r"""(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+""",
+    "llama3": r"""(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+""",
+}
+
+# the `pre` strings convert_hf_to_gguf actually writes -> pattern family
+# (nearest approximation where llama.cpp has a bespoke regex)
+_PRE_ALIASES = {
+    "llama-bpe": "llama3",  # Llama-3 vocabs (incl. DeepSeek-R1-Distill)
+    "llama3": "llama3",
+    "qwen2": "qwen2",
+    "deepseek-r1-qwen": "qwen2",  # qwen2-derived split (digits singly)
+    "deepseek-llm": "gpt2",
+    "gpt-2": "gpt2",
+}
+
+
+@dataclass
+class ByteLevelBPE(BaseTokenizer):
+    """GPT-2-style byte-level BPE over a GGUF vocab — the tokenizer family
+    of the Qwen3 / Qwen3-MoE / DeepSeek-R1-Distill (Llama-3 vocab) tiers
+    (GGUF ``tokenizer.ggml.model == "gpt2"``; rank-ordered merges in
+    ``tokenizer.ggml.merges``). Special (control/user-defined) tokens are
+    split out of the text before the merge loop, so chat-template markers
+    like <|im_start|> encode to their single ids."""
+
+    tokens: List[str]
+    merges: List[str]  # "left right" pairs, rank = list position
+    token_types: List[int]
+    bos_id: Optional[int] = None
+    eos_id: Optional[int] = None
+    pre: str = "gpt2"
+    # llama.cpp defaults add_bos FALSE for BPE vocabs (true only when the
+    # GGUF says so); real Qwen GGUFs declare bos_token_id=<endoftext> WITH
+    # add_bos_token=false, so bos_id being set must not imply prepending
+    add_bos: bool = False
+    _index: Dict[str, int] = field(default_factory=dict, repr=False)
+    _ranks: Dict[tuple, int] = field(default_factory=dict, repr=False)
+    _b2u: Dict[int, str] = field(default_factory=dict, repr=False)
+    _u2b: Dict[str, int] = field(default_factory=dict, repr=False)
+    _cache: Dict[str, List[str]] = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        import regex
+
+        self._index = {t: i for i, t in enumerate(self.tokens)}
+        self._ranks = {
+            tuple(m.split(" ", 1)): r for r, m in enumerate(self.merges)
+        }
+        self._b2u = _bytes_to_unicode()
+        self._u2b = {c: b for b, c in self._b2u.items()}
+        self._pat = regex.compile(
+            _PRE_PATTERNS[_PRE_ALIASES.get(self.pre, "gpt2")]
+        )
+        specials = [
+            t
+            for t, typ in zip(self.tokens, self.token_types)
+            if typ in (TOKEN_TYPE_CONTROL, TOKEN_TYPE_USER_DEFINED)
+        ]
+        self._special_pat = None
+        if specials:
+            self._special_pat = regex.compile(
+                "("
+                + "|".join(
+                    regex.escape(t)
+                    for t in sorted(specials, key=len, reverse=True)
+                )
+                + ")"
+            )
+
+    @classmethod
+    def from_gguf_metadata(cls, md: dict) -> "ByteLevelBPE":
+        tokens = md["tokenizer.ggml.tokens"]
+        n = len(tokens)
+        bos = md.get("tokenizer.ggml.bos_token_id")
+        eos = md.get("tokenizer.ggml.eos_token_id")
+        return cls(
+            tokens=tokens,
+            merges=list(md.get("tokenizer.ggml.merges", [])),
+            token_types=list(md.get("tokenizer.ggml.token_type", [1] * n)),
+            bos_id=int(bos) if bos is not None else None,
+            eos_id=int(eos) if eos is not None else None,
+            pre=md.get("tokenizer.ggml.pre", "gpt2"),
+            add_bos=bool(md.get("tokenizer.ggml.add_bos_token", False)),
+        )
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tokens)
+
+    def _bpe(self, word: str) -> List[str]:
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        syms = list(word)
+        while len(syms) > 1:
+            best, best_rank = None, None
+            for i in range(len(syms) - 1):
+                r = self._ranks.get((syms[i], syms[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best is None:
+                break
+            syms[best : best + 2] = [syms[best] + syms[best + 1]]
+        if len(self._cache) < 65536:
+            self._cache[word] = syms
+        return syms
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids: List[int] = []
+        # bos is prepended only when the GGUF's add_bos_token flag says so
+        # (self.add_bos) — a declared bos_token_id alone must not trigger
+        # it (Qwen GGUFs set bos_token_id=<endoftext>, add_bos_token=false)
+        if add_bos and self.add_bos and self.bos_id is not None:
+            ids.append(self.bos_id)
+        chunks = (
+            self._special_pat.split(text) if self._special_pat else [text]
+        )
+        for chunk in chunks:
+            if not chunk:
+                continue
+            sid = self._index.get(chunk)
+            if sid is not None and self._special_pat and (
+                self.token_types[sid]
+                in (TOKEN_TYPE_CONTROL, TOKEN_TYPE_USER_DEFINED)
+            ):
+                ids.append(sid)
+                continue
+            for m in self._pat.finditer(chunk):
+                word = "".join(
+                    self._b2u[b] for b in m.group().encode("utf-8")
+                )
+                for piece in self._bpe(word):
+                    idx = self._index.get(piece)
+                    if idx is not None:
+                        ids.append(idx)
+                    else:  # single-char fallback (vocab covers all bytes)
+                        ids.extend(
+                            self._index[c] for c in piece if c in self._index
+                        )
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        chars: List[str] = []
+        for i in ids:
+            if not 0 <= i < len(self.tokens):
+                continue
+            typ = self.token_types[i] if i < len(self.token_types) else 1
+            if typ == TOKEN_TYPE_CONTROL:
+                continue
+            chars.append(self.tokens[i])
+        data = bytes(
+            b
+            for ch in "".join(chars)
+            for b in (
+                [self._u2b[ch]]
+                if ch in self._u2b
+                else ch.encode("utf-8")  # user-defined tokens pass through
+            )
+        )
+        return data.decode("utf-8", errors="replace")
+
+
+def gguf_tokenizer(md: dict) -> BaseTokenizer:
+    """Build the right tokenizer for a GGUF file's embedded vocab:
+    ``tokenizer.ggml.model`` "gpt2" (byte-level BPE — Qwen/Llama-3/DeepSeek
+    families) vs "llama" (SentencePiece BPE — Llama/Mistral families)."""
+    model = md.get("tokenizer.ggml.model", "llama")
+    if model == "gpt2":
+        return ByteLevelBPE.from_gguf_metadata(md)
+    return SentencePieceBPE.from_gguf_metadata(md)
+
+
 class HFTokenizer(BaseTokenizer):
     """transformers-backed tokenizer for HF model directories."""
 
@@ -197,6 +391,17 @@ def tokenizer_to_dict(tok: BaseTokenizer) -> dict:
             "eos_id": tok.eos_id,
             "add_prefix_space": tok.add_prefix_space,
         }
+    if isinstance(tok, ByteLevelBPE):
+        return {
+            "type": "blbpe",
+            "tokens": tok.tokens,
+            "merges": tok.merges,
+            "token_types": tok.token_types,
+            "bos_id": tok.bos_id,
+            "eos_id": tok.eos_id,
+            "pre": tok.pre,
+            "add_bos": tok.add_bos,
+        }
     if isinstance(tok, HFTokenizer):
         return {"type": "hf", "path": tok._tok.name_or_path}
     return {"type": "byte"}
@@ -212,6 +417,16 @@ def tokenizer_from_dict(d: dict) -> BaseTokenizer:
             bos_id=d.get("bos_id"),
             eos_id=d.get("eos_id"),
             add_prefix_space=d.get("add_prefix_space", True),
+        )
+    if t == "blbpe":
+        return ByteLevelBPE(
+            tokens=list(d["tokens"]),
+            merges=list(d["merges"]),
+            token_types=list(d["token_types"]),
+            bos_id=d.get("bos_id"),
+            eos_id=d.get("eos_id"),
+            pre=d.get("pre", "gpt2"),
+            add_bos=bool(d.get("add_bos", False)),
         )
     if t == "hf":
         return HFTokenizer(d["path"])

@@ -47,7 +47,7 @@ from ..engine.tokenizer import (
     BaseTokenizer,
     ByteTokenizer,
     HFTokenizer,
-    SentencePieceBPE,
+    gguf_tokenizer,
 )
 
 log = logging.getLogger("aios.runtime.models")
@@ -284,7 +284,7 @@ class ModelManager:
             f = gguf_mod.GGUFFile(p)
             tokenizer: BaseTokenizer
             if "tokenizer.ggml.tokens" in f.metadata:
-                tokenizer = SentencePieceBPE.from_gguf_metadata(f.metadata)
+                tokenizer = gguf_tokenizer(f.metadata)
             else:
                 tokenizer = ByteTokenizer()
             if context_length:

@@ -454,3 +454,121 @@ def test_sp_bpe_byte_fallback_on_unknown_chars():
     assert "a" in pieces
     assert f"<0x{ord('Z'):02X}>" in pieces
     assert tok.decode(ids) == "aZ"
+
+
+# ---------------------------------------------------------------------------
+# qwen3-architecture fixture: gpt2 (byte-level BPE) tokenizer + QK-norm
+# ---------------------------------------------------------------------------
+
+
+def _write_tiny_qwen3_gguf(path, rng):
+    """A qwen3-architecture GGUF: QK-norm tensors, no q/k permutation
+    (convert_hf_to_gguf permutes llama/mistral only), and the gpt2
+    tokenizer family — byte-level vocab, rank-ordered merges, chatml
+    control tokens — that the Qwen3/Qwen3-MoE/DeepSeek tiers embed."""
+    from aios_tpu.engine.tokenizer import _bytes_to_unicode
+
+    E, F_, L, H, KH, D = 64, 96, 2, 4, 2, 16
+    alphabet = sorted(set(_bytes_to_unicode().values()))
+    merges = ["h i", "Ġ h", "Ġh i"]
+    specials = ["<|im_start|>", "<|im_end|>", "<|endoftext|>"]
+    vocab = alphabet + [m.replace(" ", "") for m in merges] + specials
+    types = [1] * (len(alphabet) + len(merges)) + [3] * len(specials)
+    V = len(vocab)
+    meta = [
+        _kv_str("general.architecture", "qwen3"),
+        _kv_str("general.name", "qwen3-fixture"),
+        _kv_u32("qwen3.block_count", L),
+        _kv_u32("qwen3.context_length", 128),
+        _kv_u32("qwen3.embedding_length", E),
+        _kv_u32("qwen3.feed_forward_length", F_),
+        _kv_u32("qwen3.attention.head_count", H),
+        _kv_u32("qwen3.attention.head_count_kv", KH),
+        _kv_u32("qwen3.attention.key_length", D),
+        _kv_f32("qwen3.attention.layer_norm_rms_epsilon", 1e-6),
+        _kv_f32("qwen3.rope.freq_base", 1000000.0),
+        _kv_str("tokenizer.ggml.model", "gpt2"),
+        _kv_str("tokenizer.ggml.pre", "qwen2"),
+        _kv_arr_str("tokenizer.ggml.tokens", vocab),
+        _kv_arr_str("tokenizer.ggml.merges", merges),
+        _kv_arr_i32("tokenizer.ggml.token_type", types),
+        _kv_u32("tokenizer.ggml.eos_token_id", vocab.index("<|im_end|>")),
+    ]
+    tensors = []
+
+    def add(name, rows, cols):
+        raw, _ = _q8_0_tensor(rng, rows, cols)
+        tensors.append((name, (rows, cols), Q8_0, raw))
+
+    add("token_embd.weight", V, E)
+    for i in range(L):
+        p = f"blk.{i}."
+        for nm, dim in (("attn_norm", E), ("ffn_norm", E),
+                        ("attn_q_norm", D), ("attn_k_norm", D)):
+            tensors.append((
+                p + nm + ".weight", (dim,), F32,
+                rng.uniform(0.5, 1.5, dim).astype(np.float32).tobytes(),
+            ))
+        add(p + "attn_q.weight", H * D, E)
+        add(p + "attn_k.weight", KH * D, E)
+        add(p + "attn_v.weight", KH * D, E)
+        add(p + "attn_output.weight", E, H * D)
+        add(p + "ffn_gate.weight", F_, E)
+        add(p + "ffn_up.weight", F_, E)
+        add(p + "ffn_down.weight", E, F_)
+    tensors.append((
+        "output_norm.weight", (E,), F32,
+        rng.uniform(0.5, 1.5, E).astype(np.float32).tobytes(),
+    ))
+    add("output.weight", V, E)
+    write_gguf(path, meta, tensors)
+    return vocab
+
+
+def test_qwen3_gguf_fixture_through_runtime(tmp_path):
+    """LoadModel on a qwen3-arch GGUF: config picks up QK-norm geometry,
+    the tokenizer dispatches to byte-level BPE, the chatml template's
+    control tokens encode to single ids, and greedy decode through the
+    engine matches the uncached full forward."""
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.tokenizer import ByteLevelBPE, render_chat
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    rng = np.random.default_rng(23)
+    path = tmp_path / "qwen3-fixture.gguf"
+    vocab = _write_tiny_qwen3_gguf(path, rng)
+    manager = ModelManager(num_slots=2, warm_compile=False)
+    managed = manager.load_model("qwen3-fixture", str(path), context_length=64)
+    assert managed.state == "ready"
+    m = manager.models["qwen3-fixture"]
+    assert m.config.qk_norm and m.config.head_dim == 16
+    assert isinstance(m.tokenizer, ByteLevelBPE)
+    assert m.tokenizer.bos_id is None
+    assert m.tokenizer.eos_id == vocab.index("<|im_end|>")
+
+    text = render_chat("qwen3-fixture", "hi")
+    ids = m.tokenizer.encode(text, add_bos=False)
+    # chat scaffolding control tokens must be single ids, and "hi" one
+    # merged token (the "h i" merge; no space marker after a newline)
+    assert ids.count(vocab.index("<|im_start|>")) == 2
+    assert vocab.index("hi") in ids  # "h i" merge applied (follows newline)
+    assert m.tokenizer.decode(ids).endswith("assistant\n")
+
+    got = m.engine.generate(ids[:8], max_new_tokens=5, temperature=0.0)
+    params = {
+        k: (jnp.asarray(v) if not isinstance(v, dict)
+            else {kk: jnp.asarray(vv) for kk, vv in v.items()})
+        for k, v in m.engine.params.items()
+    }
+    toks = list(ids[:8])
+    want = []
+    for _ in range(5):
+        logits = M.forward_full(
+            params, m.config, np.asarray([toks], np.int32)
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        want.append(nxt)
+        toks.append(nxt)
+    assert got == want

@@ -1,0 +1,223 @@
+"""Byte-level BPE tokenizer (GPT-2 family): parity vs the HF `tokenizers`
+library as ground truth, special-token handling, GGUF dispatch.
+
+The reference tokenizes inside llama.cpp; our GGUF path must reproduce the
+same two tokenizer families from the embedded vocab alone:
+SentencePiece-BPE (llama/mistral — test_gguf_spec_fixture.py) and GPT-2
+byte-level BPE with rank-ordered merges (qwen3 / qwen3-moe /
+deepseek-r1-distill's llama-3 vocab — this file).
+"""
+
+import numpy as np
+import pytest
+
+from aios_tpu.engine.tokenizer import (
+    ByteLevelBPE,
+    _bytes_to_unicode,
+    gguf_tokenizer,
+    tokenizer_from_dict,
+    tokenizer_to_dict,
+)
+
+
+def _build_pair(merge_pairs, specials=()):
+    """(our ByteLevelBPE, HF tokenizers.Tokenizer) over the same vocab."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+
+    alphabet = sorted(set(_bytes_to_unicode().values()))
+    vocab_list = alphabet + ["".join(m) for m in merge_pairs] + list(specials)
+    vocab = {t: i for i, t in enumerate(vocab_list)}
+    hf = Tokenizer(models.BPE(vocab=vocab, merges=list(merge_pairs)))
+    hf.pre_tokenizer = pre_tokenizers.ByteLevel(
+        add_prefix_space=False, use_regex=True
+    )
+    hf.decoder = decoders.ByteLevel()
+    types = [1] * (len(vocab_list) - len(specials)) + [3] * len(specials)
+    mine = ByteLevelBPE(
+        tokens=vocab_list,
+        merges=[" ".join(m) for m in merge_pairs],
+        token_types=types,
+        bos_id=None,
+        eos_id=None,
+        pre="gpt2",
+    )
+    return mine, hf
+
+
+MERGES = [
+    ("Ġ", "h"), ("e", "l"), ("l", "o"), ("Ġh", "el"), ("Ġhel", "lo"),
+    ("Ġ", "w"), ("o", "r"), ("l", "d"), ("Ġw", "or"), ("Ġwor", "ld"),
+    ("1", "2"), ("12", "3"),
+]
+
+SAMPLES = [
+    "hello world",
+    "hello hello world!",
+    "  leading and   multiple spaces",
+    "tabs\tand\nnewlines\r\n",
+    "numbers 123456 mixed42",
+    "punct!!! ...and, (parens) [brackets]",
+    "unicode héllo wörld — em-dash … ellipsis",
+    "emoji 🙂 and CJK 你好世界",
+    "don't stop can't won't it's",
+    "CamelCase and snake_case and SCREAMING",
+    "",
+    " ",
+    "\n\n\n",
+]
+
+
+@pytest.mark.parametrize("text", SAMPLES)
+def test_parity_with_hf_tokenizers_gpt2(text):
+    mine, hf = _build_pair(MERGES)
+    assert mine.encode(text, add_bos=False) == hf.encode(text).ids
+
+
+def test_decode_roundtrips():
+    mine, hf = _build_pair(MERGES)
+    for text in SAMPLES:
+        ids = mine.encode(text, add_bos=False)
+        assert mine.decode(ids) == text
+        assert mine.decode(ids) == hf.decode(hf.encode(text).ids)
+
+
+def test_parity_fuzz_random_strings():
+    mine, hf = _build_pair(MERGES)
+    rng = np.random.default_rng(0)
+    pool = list("helo wrd123!?.éß中\n\t'")
+    for _ in range(50):
+        n = int(rng.integers(1, 40))
+        text = "".join(rng.choice(pool) for _ in range(n))
+        assert mine.encode(text, add_bos=False) == hf.encode(text).ids, text
+        assert mine.decode(mine.encode(text, add_bos=False)) == text
+
+
+def test_special_tokens_encode_to_single_ids():
+    specials = ["<|im_start|>", "<|im_end|>"]
+    mine, _ = _build_pair(MERGES, specials=specials)
+    start_id = mine.tokens.index("<|im_start|>")
+    end_id = mine.tokens.index("<|im_end|>")
+    ids = mine.encode(
+        "<|im_start|>hello world<|im_end|>", add_bos=False
+    )
+    assert ids[0] == start_id and ids[-1] == end_id
+    inner = mine.encode("hello world", add_bos=False)
+    assert ids[1:-1] == inner
+    # control tokens are skipped on decode (chat scaffolding vanishes)
+    assert mine.decode(ids) == "hello world"
+
+
+def test_qwen2_pattern_splits_digits_individually():
+    """The qwen2 pretokenizer splits every digit; gpt2 keeps runs."""
+    mine_gpt2, _ = _build_pair(MERGES)
+    mine_qwen = ByteLevelBPE(
+        tokens=mine_gpt2.tokens,
+        merges=mine_gpt2.merges,
+        token_types=mine_gpt2.token_types,
+        pre="qwen2",
+    )
+    g = mine_gpt2.encode("123", add_bos=False)
+    q = mine_qwen.encode("123", add_bos=False)
+    # gpt2 merges "123" via the 12+3 merges; qwen2 never sees the pair
+    assert g == [mine_gpt2.tokens.index("123")]
+    assert q == [mine_qwen.tokens.index(c) for c in "123"]
+
+
+def test_serialization_roundtrip():
+    mine, _ = _build_pair(MERGES, specials=["<|endoftext|>"])
+    d = tokenizer_to_dict(mine)
+    assert d["type"] == "blbpe"
+    back = tokenizer_from_dict(d)
+    for text in SAMPLES:
+        assert back.encode(text, add_bos=False) == mine.encode(
+            text, add_bos=False
+        )
+
+
+def test_gguf_dispatch_by_tokenizer_model():
+    md_bpe = {
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.pre": "qwen2",
+        "tokenizer.ggml.tokens": ["a", "b", "<|im_start|>"],
+        "tokenizer.ggml.merges": ["a b"],
+        "tokenizer.ggml.token_type": [1, 1, 3],
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    tok = gguf_tokenizer(md_bpe)
+    assert isinstance(tok, ByteLevelBPE)
+    assert tok.pre == "qwen2"
+    assert tok.bos_id is None and tok.eos_id == 2
+
+    from aios_tpu.engine.tokenizer import SentencePieceBPE
+
+    md_sp = {
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": ["<unk>", "<s>", "</s>", "▁hi"],
+        "tokenizer.ggml.scores": [0.0, 0.0, 0.0, -1.0],
+        "tokenizer.ggml.token_type": [2, 3, 3, 1],
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    assert isinstance(gguf_tokenizer(md_sp), SentencePieceBPE)
+    # absent key defaults to the SentencePiece family (llama/mistral)
+    assert isinstance(
+        gguf_tokenizer({k: v for k, v in md_sp.items()
+                        if k != "tokenizer.ggml.model"}),
+        SentencePieceBPE,
+    )
+
+
+def test_no_bos_when_vocab_declares_none():
+    mine, _ = _build_pair(MERGES)
+    assert mine.encode("hello", add_bos=True) == mine.encode(
+        "hello", add_bos=False
+    )
+
+
+def test_bos_requires_add_bos_token_flag():
+    """Real Qwen GGUFs declare bos_token_id=<endoftext> WITH
+    add_bos_token=false — a declared bos id alone must not prepend."""
+    md = {
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": ["a", "b", "<|endoftext|>"],
+        "tokenizer.ggml.merges": [],
+        "tokenizer.ggml.token_type": [1, 1, 3],
+        "tokenizer.ggml.bos_token_id": 2,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    tok = gguf_tokenizer(md)
+    assert tok.encode("a", add_bos=True) == [0]
+    md["tokenizer.ggml.add_bos_token"] = True
+    tok2 = gguf_tokenizer(md)
+    assert tok2.encode("a", add_bos=True) == [2, 0]
+    # the flag survives checkpoint serialization
+    back = tokenizer_from_dict(tokenizer_to_dict(tok2))
+    assert back.encode("a", add_bos=True) == [2, 0]
+
+
+def test_pre_aliases_map_real_gguf_names():
+    """convert_hf_to_gguf writes pre="llama-bpe" for Llama-3 vocabs and
+    "deepseek-r1-qwen" for R1-distill-qwen; both must leave the gpt2
+    fallback (digit-run handling differs)."""
+    base = dict(
+        tokens=sorted(set(_bytes_to_unicode().values())) + ["123"],
+        merges=["1 2", "12 3"],
+        token_types=None,
+    )
+    toks = {}
+    for pre in ("llama-bpe", "deepseek-r1-qwen", "gpt2"):
+        toks[pre] = ByteLevelBPE(
+            tokens=base["tokens"],
+            merges=base["merges"],
+            token_types=[1] * len(base["tokens"]),
+            pre=pre,
+        )
+    # gpt2 merges the digit run "1234" into 123+4; llama3 (llama-bpe)
+    # splits digit runs into <=3-char groups; qwen2-family splits singly
+    g = toks["gpt2"].encode("1234", add_bos=False)
+    l3 = toks["llama-bpe"].encode("1234", add_bos=False)
+    qw = toks["deepseek-r1-qwen"].encode("1234", add_bos=False)
+    idx = {t: i for i, t in enumerate(base["tokens"])}
+    assert g == [idx["123"], idx["4"]]
+    assert l3 == [idx["123"], idx["4"]]
+    assert qw == [idx["1"], idx["2"], idx["3"], idx["4"]]
