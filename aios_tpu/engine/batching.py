@@ -42,6 +42,10 @@ class Request:
     top_p: float = 0.95
     stop_ids: Tuple[int, ...] = ()
     request_id: str = ""
+    # grammar-constrained decoding: output restricted to one JSON object
+    # (the reference's non-streaming response_format=json_object behavior,
+    # inference.rs:114-122, realized with logit masks instead of GBNF)
+    json_mode: bool = False
 
 
 @dataclass
@@ -53,6 +57,7 @@ class _Live:
     first_token_at: float = 0.0
     submitted_at: float = 0.0
     done: bool = False
+    constraint: object = None  # jsonmode.JsonConstraint when json_mode
 
 
 class RequestHandle:
@@ -92,8 +97,11 @@ class ContinuousBatcher:
         speculative: bool = False,  # n-gram speculative decode dispatches
         spec_draft_len: int = 7,
         spec_ngram: int = 3,
+        tokenizer=None,  # enables json_mode requests (mask table source)
     ) -> None:
         self.engine = engine
+        self.tokenizer = tokenizer
+        self._json_masks = None  # lazy jsonmode.JsonMaskCache
         self.chunk_steps = chunk_steps
         self.admit_chunk_steps = admit_chunk_steps
         # Speculative dispatches (engine.spec_step) emit 1..draft_len+1
@@ -159,6 +167,23 @@ class ContinuousBatcher:
 
     # -- public API ---------------------------------------------------------
 
+    def _json_mask_cache(self):
+        """Lazily build the per-model mask cache (one vocab walk)."""
+        if self._json_masks is None:
+            from . import jsonmode
+
+            if self.tokenizer is None:
+                raise ValueError(
+                    "json_mode requires the batcher to know the tokenizer"
+                )
+            table = jsonmode.token_bytes_table(
+                self.tokenizer, self.engine.cfg.vocab_size
+            )
+            self._json_masks = jsonmode.JsonMaskCache(
+                table, getattr(self.tokenizer, "eos_id", None)
+            )
+        return self._json_masks
+
     def submit(self, req: Request) -> RequestHandle:
         if not req.prompt_ids:
             # fail fast on the caller's thread — an exception on the
@@ -167,6 +192,12 @@ class ContinuousBatcher:
         if not req.request_id:
             req.request_id = f"req-{next(self._ids)}"
         live = _Live(req=req, slot=-1, submitted_at=time.monotonic())
+        if req.json_mode:
+            from . import jsonmode
+
+            # built on the CALLER's thread (fail fast + keep the vocab
+            # walk off the scheduler thread)
+            live.constraint = jsonmode.JsonConstraint(self._json_mask_cache())
         with self._qlock:
             self._waiting.append(live)
         self._wake.set()
@@ -213,6 +244,8 @@ class ContinuousBatcher:
         if first is not None:
             self._prefilling = None
             self._reserved_slot = -1
+            if live.constraint is not None:
+                first = self._constrained_first(live, first)
             live.first_token_at = time.monotonic()
             with self._lock:
                 self._live[live.slot] = live
@@ -296,10 +329,25 @@ class ContinuousBatcher:
                     live.done = True
                     live.out_q.put(_END)
                 return
+            if live.constraint is not None:
+                first = self._constrained_first(live, first)
             live.first_token_at = time.monotonic()
             with self._lock:
                 self._live[slot] = live
             self._emit(live, first)
+
+    def _constrained_first(self, live: _Live, first: int) -> int:
+        """Grammar-constrained requests overwrite the unmasked first token
+        sampled by prefill with the grammar's forced opener ('{')."""
+        cache = live.constraint.cache
+        forced = cache.start_token_id
+        if forced is None:  # no "{" token in vocab: fail open, unconstrained
+            log.warning("json_mode: vocab has no '{' token; unconstrained")
+            live.constraint = None
+            return first
+        self.engine.force_pending_token(live.slot, forced)
+        live.constraint.advance(forced)
+        return forced
 
     def _emit(self, live: _Live, token: int) -> None:
         live.produced += 1
@@ -397,6 +445,44 @@ class ContinuousBatcher:
         with self._qlock:
             anyone_waiting = bool(self._waiting) or self._prefilling is not None
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
+        constrained = [
+            (s_, l) for s_, l in slots.items() if l.constraint is not None
+        ]
+        if constrained:
+            # grammar masks change per emitted token, so constrained slots
+            # ride 1-step dispatches; unconstrained co-residents decode
+            # unmasked (zero rows) in the same batch. Rows are cached
+            # DEVICE-resident per automaton state, so the [S, V] mask
+            # assembles on device — no per-step PCIe traffic.
+            import jax.numpy as jnp
+
+            cache = self._json_mask_cache()
+            by_slot = dict(constrained)
+            rows = [
+                (
+                    by_slot[s_].constraint.device_mask(
+                        remaining=by_slot[s_].req.max_tokens
+                        - by_slot[s_].produced
+                    )
+                    if s_ in by_slot
+                    else cache.zeros_row()
+                )
+                for s_ in range(self.engine.num_slots)
+            ]
+            mask = jnp.stack(rows)
+            try:
+                tokens = self.engine.step_masked(mask)
+            except PoolExhausted:
+                self._evict_longest()
+                return
+            for slot, live in list(slots.items()):
+                if live.done:
+                    continue
+                tok = int(tokens[0, slot])
+                if live.constraint is not None:
+                    live.constraint.advance(tok)
+                self._emit(live, tok)
+            return
         if self.speculative:
             # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
             # run in order; _emit retires requests mid-dispatch as usual

@@ -239,12 +239,15 @@ class TPUEngine:
 
     # -- jitted cores -------------------------------------------------------
 
-    def _step_impl(self, params, state: DecodeState, n_steps: int, tables=None):
+    def _step_impl(self, params, state: DecodeState, n_steps: int, tables=None,
+                   mask=None):
         """The decode scan. ``tables`` (paged engines only) is the host
         allocator's [S, MB] block->page map riding along with the dispatch;
         only the model call differs between the dense, int8-KV and paged
         cache layouts — sampling, history gating and the state rebuild are
-        shared."""
+        shared. ``mask`` [S, V] fp32 adds to the logits before sampling —
+        the grammar-constraint hook (engine/jsonmode.py), step_masked only.
+        """
 
         def one(carry, _):
             st = carry
@@ -296,7 +299,12 @@ class TPUEngine:
                     attn_impl=self._attn_impl,
                     moe_impl=self._moe_impl,
                 )
-            next_tokens = sampling.sample(logits, sub, st["temps"], st["top_ps"])
+            if mask is not None:
+                logits = logits + mask
+            next_tokens = sampling.sample(
+                logits, sub, st["temps"], st["top_ps"],
+                exact=mask is not None,
+            )
             slots = jnp.arange(self.num_slots)
             # new token's history col is lengths+1 (<= C, inside the pad);
             # inactive slots — retired or MID-CHUNKED-PREFILL — write to the
@@ -635,6 +643,24 @@ class TPUEngine:
             self._step_fns[n_steps] = fn
         return fn
 
+    def _masked_step_fn(self):
+        """1-step decode with an additive per-slot logits mask (grammar-
+        constrained decoding); same donated state contract as _step_fn."""
+        fn = self._step_fns.get("masked")
+        if fn is None:
+            if self.paged:
+                fn = jax.jit(
+                    lambda p, s, t, m: self._step_impl(p, s, 1, t, m),
+                    donate_argnums=(1,),
+                )
+            else:
+                fn = jax.jit(
+                    lambda p, s, m: self._step_impl(p, s, 1, None, m),
+                    donate_argnums=(1,),
+                )
+            self._step_fns["masked"] = fn
+        return fn
+
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is None:
@@ -890,6 +916,45 @@ class TPUEngine:
                 self._host_lengths + n_steps, self.max_context - 1
             )
             return np.asarray(tokens)
+
+    def step_masked(self, mask: np.ndarray) -> np.ndarray:
+        """One batched decode step with a per-slot ADDITIVE logits mask
+        [num_slots, vocab] fp32 (0 = allowed, -inf = forbidden) applied
+        before sampling — grammar-constrained decoding (jsonmode.py).
+        Returns tokens [1, num_slots]."""
+        with self._lock:
+            m = jnp.asarray(mask, jnp.float32)
+            if self.paged:
+                self._back_active_slots(1)
+                self.state, tokens = self._masked_step_fn()(
+                    self.params, self.state,
+                    jnp.asarray(self.allocator.tables), m,
+                )
+            else:
+                self.state, tokens = self._masked_step_fn()(
+                    self.params, self.state, m
+                )
+            self.decode_steps += 1
+            self._host_lengths = np.minimum(
+                self._host_lengths + 1, self.max_context - 1
+            )
+            return np.asarray(tokens)
+
+    def force_pending_token(self, slot: int, token_id: int) -> None:
+        """Replace ``slot``'s pending (sampled-but-not-yet-consumed) token.
+
+        Grammar-constrained requests use this right after prefill: the
+        prefill graph samples the first token UNMASKED, so the batcher
+        overwrites it with the grammar's forced opener (e.g. "{" for
+        json_object mode) before any decode dispatch consumes it."""
+        with self._lock:
+            col = int(self._host_lengths[slot])
+            self.state["last_tokens"] = (
+                self.state["last_tokens"].at[slot].set(token_id)
+            )
+            self.state["history"] = (
+                self.state["history"].at[slot, col].set(token_id)
+            )
 
     def spec_step(
         self, n_rounds: int = 8, draft_len: int = 7, ngram: int = 3

@@ -77,6 +77,7 @@ def sample(
     top_p: jnp.ndarray,  # [B]; 1.0 keeps the whole candidate pool (the pool
     # itself is still capped, see below — NOT a full-vocab nucleus)
     top_k: jnp.ndarray | None = None,  # [B] int32; 0 => the whole pool
+    exact: bool = False,  # exact top-k pool (grammar-masked steps)
 ) -> jnp.ndarray:
     """Sample one token per row; temperature < GREEDY_EPS rows take argmax.
 
@@ -99,9 +100,17 @@ def sample(
     # deterministic. Missing a tail candidate with ~5% probability is well
     # within the tolerance of a sampling pool (llama.cpp's own chain
     # truncates harder, top-k 40). Results come back sorted descending.
-    vals, idx = jax.lax.approx_max_k(
-        logits / temp, K, recall_target=0.95
-    )  # [B, K] sorted desc
+    if exact:
+        # Grammar-constrained steps MUST use the exact pool: the additive
+        # mask can leave only a handful of allowed tokens (sometimes just
+        # EOS), and approx_max_k's ~5% per-token miss rate could build a
+        # pool with zero allowed entries — softmax over uniform -1e30s
+        # would then emit a forbidden token and break the JSON guarantee.
+        vals, idx = jax.lax.top_k(logits / temp, K)
+    else:
+        vals, idx = jax.lax.approx_max_k(
+            logits / temp, K, recall_target=0.95
+        )  # [B, K] sorted desc
     if top_k is not None:
         kk = jnp.where(top_k <= 0, K, jnp.minimum(top_k, K))
         pos = jnp.arange(K)[None, :]

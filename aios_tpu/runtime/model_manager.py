@@ -231,7 +231,9 @@ class ModelManager:
             del params
             if self.warm_compile:
                 engine.warmup()
-            batcher = ContinuousBatcher(engine, speculative=self.speculative)
+            batcher = ContinuousBatcher(
+                engine, speculative=self.speculative, tokenizer=tokenizer
+            )
             managed = ManagedModel(
                 name=name,
                 config=cfg,

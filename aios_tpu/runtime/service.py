@@ -17,6 +17,7 @@ detokenized text; the final chunk has done=true and empty text.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Iterator, Optional
 
@@ -124,7 +125,7 @@ class RuntimeService(AIRuntimeServicer):
         m = self._resolve_model(request, context)
         if m is None:
             return
-        handle, _ = self._submit(m, request)
+        handle, _ = self._submit(m, request, streaming=True)
         emitted = ""
         ids = []
         for tok in handle:
@@ -144,13 +145,25 @@ class RuntimeService(AIRuntimeServicer):
 
     # -- helpers ------------------------------------------------------------
 
-    def _submit(self, m: ManagedModel, request):
+    def _submit(self, m: ManagedModel, request, streaming: bool = False):
         m.touch()
         prompt_text = render_chat(
             m.config.name, request.prompt, request.system_prompt
         )
         prompt_ids = m.tokenizer.encode(prompt_text)
         stop = (m.tokenizer.eos_id,) if m.tokenizer.eos_id is not None else ()
+        # The reference forces response_format=json_object on every
+        # NON-streaming local inference (inference.rs:114-122, enforced by
+        # llama-server's grammar engine). The TPU equivalent is logit-mask
+        # grammar decoding (engine/jsonmode.py). Conscious default: OFF —
+        # the blanket force would garble plain-text think() flows that the
+        # reference only gets away with because its prompts all demand
+        # JSON; AIOS_TPU_JSON_MODE=force restores exact reference behavior.
+        json_mode = (
+            not streaming
+            and os.environ.get("AIOS_TPU_JSON_MODE", "").lower()
+            in ("force", "1", "on")
+        )
         req = Request(
             prompt_ids=prompt_ids,
             max_tokens=request.max_tokens or DEFAULT_MAX_TOKENS,
@@ -162,6 +175,7 @@ class RuntimeService(AIRuntimeServicer):
             top_p=DEFAULT_TOP_P,
             stop_ids=stop,
             request_id=request.task_id or "",
+            json_mode=json_mode,
         )
         return m.batcher.submit(req), len(prompt_ids)
 

@@ -1,0 +1,265 @@
+"""Grammar-constrained JSON decoding (engine/jsonmode.py).
+
+The reference forces response_format=json_object on every non-streaming
+local inference and leans on llama-server's GBNF engine to make the output
+parse (runtime/src/inference.rs:114-122); the TPU engine realizes the same
+guarantee with a byte-level JSON automaton and per-step logit masks.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu.engine import jsonmode
+from aios_tpu.engine import model as M
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.engine.tokenizer import ByteTokenizer
+
+
+# ---------------------------------------------------------------------------
+# automaton
+# ---------------------------------------------------------------------------
+
+ACCEPT = [
+    b'{"a": 1}',
+    b'{ }',
+    b'{"a": [1, 2.5e3, -0.25, true, false, null, "x"]}',
+    b'{"nested": {"deep": {"x": "y"}}, "b": []}',
+    b'{"esc": "a\\n\\t\\u00e9\\\\"}',
+    b'  {"ws": 1}  ',
+    b'{"unicode": "h\xc3\xa9llo"}',
+]
+
+REJECT = [
+    b"{",  # unterminated
+    b'{"a" 1}',  # missing colon
+    b"[1]",  # top level must be an object (json_object mode)
+    b'{"a":01}',  # leading zero
+    b'{"a":1,}',  # trailing comma
+    b'{"a":1}}',  # extra closer
+    b"{'a':1}",  # single quotes
+    b'{"a":+1}',  # plus sign
+    b'{"a":.5}',  # bare fraction
+    b'{"a":1 "b":2}',  # missing comma
+    b'{"a"}',  # key without value
+    b'{"a":tru}',  # bad literal
+]
+
+
+@pytest.mark.parametrize("sample", ACCEPT)
+def test_pda_accepts(sample):
+    end = jsonmode.run_bytes(jsonmode.start_state(), sample)
+    assert end is not None and jsonmode.is_terminal(end), sample
+
+
+@pytest.mark.parametrize("sample", REJECT)
+def test_pda_rejects(sample):
+    end = jsonmode.run_bytes(jsonmode.start_state(), sample)
+    assert end is None or not jsonmode.is_terminal(end), sample
+
+
+def test_pda_depth_cap():
+    deep = b'{"a":' * 20
+    assert jsonmode.run_bytes(jsonmode.start_state(), deep, max_depth=8) is None
+    ok = b'{"a":' * 6
+    assert jsonmode.run_bytes(jsonmode.start_state(), ok, max_depth=8) is not None
+
+
+def test_pda_fuzz_against_json_loads():
+    """Any byte string the PDA accepts as terminal must json.loads to a
+    dict; sampled by random walks over the closing mask."""
+    tok = ByteTokenizer()
+    table = jsonmode.token_bytes_table(tok, tok.vocab_size)
+    cache = jsonmode.JsonMaskCache(table, tok.eos_id)
+    rng = np.random.default_rng(0)
+    for _ in range(30):
+        state = cache.start()
+        out = []
+        for step in range(60):
+            row = (
+                cache.mask_row(state)
+                if step < 30
+                else cache.closing_row(state)
+            )
+            allowed = np.flatnonzero(row == 0.0)
+            allowed = allowed[allowed != tok.eos_id]
+            if len(allowed) == 0:
+                break
+            tid = int(rng.choice(allowed))
+            out.append(tid)
+            state = jsonmode.run_bytes(state, table[tid])
+            assert state is not None
+            if jsonmode.is_terminal(state):
+                break
+        assert jsonmode.is_terminal(state)
+        parsed = json.loads(bytes(out).decode("utf-8", "replace"))
+        assert isinstance(parsed, dict)
+
+
+def test_mask_row_matches_single_byte_transitions():
+    tok = ByteTokenizer()
+    table = jsonmode.token_bytes_table(tok, tok.vocab_size)
+    cache = jsonmode.JsonMaskCache(table, tok.eos_id)
+    state = jsonmode.run_bytes(cache.start(), b'{"k": ')
+    row = cache.mask_row(state)
+    for b in range(256):
+        ok = jsonmode.next_state(state, b) is not None
+        assert (row[b] == 0.0) == ok, b
+    # EOS masked: value still open
+    assert row[tok.eos_id] == jsonmode.NEG_INF
+    done = jsonmode.run_bytes(cache.start(), b'{"k": 1}')
+    assert cache.mask_row(done)[tok.eos_id] == 0.0
+
+
+def test_closing_row_walks_to_terminal():
+    tok = ByteTokenizer()
+    table = jsonmode.token_bytes_table(tok, tok.vocab_size)
+    cache = jsonmode.JsonMaskCache(table, tok.eos_id)
+    state = jsonmode.run_bytes(cache.start(), b'{"a": {"b": [1, {"c": "xy')
+    steps = 0
+    while not jsonmode.is_terminal(state):
+        row = cache.closing_row(state)
+        allowed = np.flatnonzero(row == 0.0)
+        allowed = allowed[allowed != tok.eos_id]
+        assert len(allowed) > 0
+        state = jsonmode.run_bytes(state, table[int(allowed[0])])
+        assert state is not None
+        steps += 1
+        assert steps < 32, "closing must converge"
+    # at terminal, closing mask admits ONLY eos
+    row = cache.closing_row(state)
+    assert row[tok.eos_id] == 0.0
+    assert (row == 0.0).sum() == 1
+
+
+def test_token_bytes_tables():
+    from aios_tpu.engine.tokenizer import ByteLevelBPE, SentencePieceBPE
+
+    sp = SentencePieceBPE(
+        tokens=["<unk>", "<s>", "</s>", "▁hi", "<0x7B>", "x"],
+        scores=[0.0] * 6,
+        token_types=[2, 3, 3, 1, 6, 1],
+    )
+    t = jsonmode.token_bytes_table(sp, 6)
+    assert t[1] is None and t[2] is None  # control
+    assert t[3] == b" hi"  # spiece space convention
+    assert t[4] == b"{"  # byte token
+    bl = ByteLevelBPE(
+        tokens=["{", "Ġa", "<|im_end|>"],
+        merges=[],
+        token_types=[1, 1, 3],
+    )
+    t2 = jsonmode.token_bytes_table(bl, 3)
+    assert t2[0] == b"{" and t2[1] == b" a" and t2[2] is None
+
+
+# ---------------------------------------------------------------------------
+# constrained generation through the engine + batcher
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serving():
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = TPUEngine(cfg, params, num_slots=2, max_context=128,
+                    cache_dtype=jnp.float32)
+    tok = ByteTokenizer()
+    batcher = ContinuousBatcher(eng, tokenizer=tok)
+    yield eng, tok, batcher
+    batcher.shutdown()
+    eng.close()
+
+
+@pytest.mark.parametrize("max_tokens", [25, 40, 80])
+def test_constrained_generation_parses(serving, max_tokens):
+    _, tok, batcher = serving
+    h = batcher.submit(Request(
+        prompt_ids=tok.encode("emit json"),
+        max_tokens=max_tokens,
+        temperature=0.9,
+        top_p=0.95,
+        stop_ids=(tok.eos_id,),
+        json_mode=True,
+    ))
+    text = tok.decode(h.tokens())
+    parsed = json.loads(text)  # must not raise — the whole point
+    assert isinstance(parsed, dict)
+
+
+def test_mixed_constrained_and_plain_batch(serving):
+    _, tok, batcher = serving
+    h1 = batcher.submit(Request(
+        prompt_ids=tok.encode("json"), max_tokens=40, temperature=0.8,
+        stop_ids=(tok.eos_id,), json_mode=True,
+    ))
+    h2 = batcher.submit(Request(
+        prompt_ids=tok.encode("plain"), max_tokens=15, temperature=0.8,
+        stop_ids=(tok.eos_id,),
+    ))
+    t1, t2 = h1.tokens(), h2.tokens()
+    assert isinstance(json.loads(tok.decode(t1)), dict)
+    assert 0 < len(t2) <= 15  # co-resident unconstrained stream unaffected
+
+
+def test_json_mode_without_tokenizer_fails_fast():
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    eng = TPUEngine(cfg, params, num_slots=1, max_context=64,
+                    cache_dtype=jnp.float32)
+    batcher = ContinuousBatcher(eng)  # no tokenizer
+    try:
+        with pytest.raises(ValueError, match="tokenizer"):
+            batcher.submit(Request(
+                prompt_ids=[1, 2], max_tokens=8, json_mode=True,
+            ))
+    finally:
+        batcher.shutdown()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# reference-parity env switch at the service surface
+# ---------------------------------------------------------------------------
+
+
+def test_force_json_mode_over_grpc(monkeypatch):
+    """AIOS_TPU_JSON_MODE=force restores the reference's non-streaming
+    json_object behavior at the AIRuntime surface; streaming stays free."""
+    monkeypatch.setenv("AIOS_TPU_JSON_MODE", "force")
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    manager = ModelManager(num_slots=2, warm_compile=False)
+    server, _service, port = serve(
+        address="127.0.0.1:0", manager=manager, block=False
+    )
+    try:
+        stub = services.AIRuntimeStub(
+            rpc.insecure_channel(f"127.0.0.1:{port}")
+        )
+        r = stub.LoadModel(runtime_pb2.LoadModelRequest(
+            model_name="tiny", model_path="synthetic://tiny-test",
+            context_length=128,
+        ))
+        assert r.status == "ready"
+        resp = stub.Infer(runtime_pb2.InferRequest(
+            model="tiny", prompt="status report", max_tokens=48,
+            temperature=0.9,
+        ))
+        parsed = json.loads(resp.text)
+        assert isinstance(parsed, dict)
+        # streaming is exempt (the reference only forces non-streaming)
+        chunks = list(stub.StreamInfer(runtime_pb2.InferRequest(
+            model="tiny", prompt="stream", max_tokens=8, temperature=0.9,
+        )))
+        assert chunks[-1].done
+    finally:
+        server.stop(0)
