@@ -102,6 +102,7 @@ class ContinuousBatcher:
         self.engine = engine
         self.tokenizer = tokenizer
         self._json_masks = None  # lazy jsonmode.JsonMaskCache
+        self._json_masks_lock = threading.Lock()
         self.chunk_steps = chunk_steps
         self.admit_chunk_steps = admit_chunk_steps
         # Speculative dispatches (engine.spec_step) emit 1..draft_len+1
@@ -168,21 +169,24 @@ class ContinuousBatcher:
     # -- public API ---------------------------------------------------------
 
     def _json_mask_cache(self):
-        """Lazily build the per-model mask cache (one vocab walk)."""
-        if self._json_masks is None:
-            from . import jsonmode
+        """Lazily build the per-model mask cache (one vocab walk; locked —
+        concurrent first json_mode submits from the gRPC pool must share
+        ONE cache, not each walk the vocab)."""
+        with self._json_masks_lock:
+            if self._json_masks is None:
+                from . import jsonmode
 
-            if self.tokenizer is None:
-                raise ValueError(
-                    "json_mode requires the batcher to know the tokenizer"
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "json_mode requires the batcher to know the tokenizer"
+                    )
+                table = jsonmode.token_bytes_table(
+                    self.tokenizer, self.engine.cfg.vocab_size
                 )
-            table = jsonmode.token_bytes_table(
-                self.tokenizer, self.engine.cfg.vocab_size
-            )
-            self._json_masks = jsonmode.JsonMaskCache(
-                table, getattr(self.tokenizer, "eos_id", None)
-            )
-        return self._json_masks
+                self._json_masks = jsonmode.JsonMaskCache(
+                    table, getattr(self.tokenizer, "eos_id", None)
+                )
+            return self._json_masks
 
     def submit(self, req: Request) -> RequestHandle:
         if not req.prompt_ids:
@@ -436,15 +440,6 @@ class ContinuousBatcher:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
-        # keep admission latency low when someone is waiting. n is always
-        # one of exactly TWO values — each step size is its own XLA graph,
-        # so clamping n to a data-dependent remaining-budget (as an earlier
-        # version did) triggers fresh multi-second compiles on this thread
-        # mid-serving; overshooting a request's max_tokens just produces
-        # ignored tokens, which costs microseconds instead
-        with self._qlock:
-            anyone_waiting = bool(self._waiting) or self._prefilling is not None
-        n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
         constrained = [
             (s_, l) for s_, l in slots.items() if l.constraint is not None
         ]
@@ -483,6 +478,16 @@ class ContinuousBatcher:
                     live.constraint.advance(tok)
                 self._emit(live, tok)
             return
+        # keep admission latency low when someone is waiting (constrained
+        # ticks above ignore chunking — they are always 1 step). n is
+        # always one of exactly TWO values — each step size is its own XLA
+        # graph, so clamping n to a data-dependent remaining-budget (as an
+        # earlier version did) triggers fresh multi-second compiles on this
+        # thread mid-serving; overshooting a request's max_tokens just
+        # produces ignored tokens, which costs microseconds instead
+        with self._qlock:
+            anyone_waiting = bool(self._waiting) or self._prefilling is not None
+        n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
         if self.speculative:
             # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
             # run in order; _emit retires requests mid-dispatch as usual

@@ -59,6 +59,7 @@ class TPUEngine:
         paged_pool_rows: Optional[int] = None,  # physical KV rows -> paged
         page_size: int = 128,
         prefix_cache: Optional[bool] = None,  # None -> on when paged
+        seq_sharded_cache: bool = False,  # shard KV context axis over sp
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -107,6 +108,24 @@ class TPUEngine:
             if quantize:
                 self.params = model.quantize_params(self.params)
 
+        # Context-sharded KV: the cache's C axis splits over the mesh's sp
+        # axis, so one slot's KV can exceed a single chip's HBM — XLA
+        # partitions the decode attention over the sharded contraction
+        # (partial softmax stats + psum over sp; sharding.CACHE_SPEC_SEQ).
+        self.seq_sharded = bool(seq_sharded_cache)
+        if self.seq_sharded:
+            if shardings is None:
+                raise ValueError("seq_sharded_cache needs a sharding plan")
+            if paged_pool_rows is not None:
+                raise ValueError(
+                    "seq_sharded_cache and the paged pool are exclusive"
+                )
+            if self.max_context % shardings.sp:
+                raise ValueError(
+                    f"max_context {self.max_context} must divide by "
+                    f"sp={shardings.sp} for a context-sharded cache"
+                )
+
         # Ragged decode attention under shard_map: auto on TPU meshes with a
         # bf16 cache long enough for the kernel to win (same crossover as
         # the single-chip ladder); force with sharded_attention=True to
@@ -117,7 +136,13 @@ class TPUEngine:
                 "sharded_attention=True needs a sharding plan and a bf16 KV "
                 "cache (the ragged kernel reads bf16 caches only)"
             )
-        if shardings is not None and not self.quant_cache:
+        if sharded_attention and self.seq_sharded:
+            raise ValueError(
+                "sharded_attention=True is incompatible with "
+                "seq_sharded_cache: the shard_map ragged kernel assumes "
+                "each device holds whole slots' context"
+            )
+        if shardings is not None and not self.quant_cache and not self.seq_sharded:
             on_tpu = False
             try:
                 on_tpu = jax.default_backend() == "tpu"
@@ -187,7 +212,8 @@ class TPUEngine:
                 cfg, num_slots, self.max_context, cache_dtype
             )
         if shardings is not None:
-            k, v = shardings.put_cache(k), shardings.put_cache(v)
+            k = shardings.put_cache(k, seq_shard=self.seq_sharded)
+            v = shardings.put_cache(v, seq_shard=self.seq_sharded)
         self.state: DecodeState = {
             "k": k,
             "v": v,
@@ -218,8 +244,12 @@ class TPUEngine:
                     cfg, num_slots, self.max_context
                 )
                 if shardings is not None:
-                    k_s = shardings.put_cache_scales(k_s)
-                    v_s = shardings.put_cache_scales(v_s)
+                    k_s = shardings.put_cache_scales(
+                        k_s, seq_shard=self.seq_sharded
+                    )
+                    v_s = shardings.put_cache_scales(
+                        v_s, seq_shard=self.seq_sharded
+                    )
             self.state["k_s"] = k_s
             self.state["v_s"] = v_s
 

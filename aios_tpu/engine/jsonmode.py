@@ -267,14 +267,41 @@ def token_bytes_table(tokenizer, vocab_size: int) -> List[Optional[bytes]]:
     elif isinstance(tokenizer, ByteTokenizer):
         for i in range(min(256, vocab_size)):
             table[i] = bytes([i])
-    else:  # HFTokenizer: decode each id individually (slow path, once)
-        for i in range(vocab_size):
-            try:
-                s = tokenizer.decode([i])
-            except Exception:  # noqa: BLE001
-                continue
-            if s:
-                table[i] = s.encode("utf-8")
+    else:  # HFTokenizer: map via the underlying vocab's token STRINGS —
+        # per-id decode() would strip SentencePiece's leading-space marker
+        # (decode(["▁7"]) == "7") and the automaton would track different
+        # bytes than the emitted text, breaking the parse guarantee
+        conv = getattr(tokenizer, "_tok", None)
+        if conv is not None and hasattr(conv, "convert_ids_to_tokens"):
+            toks = conv.convert_ids_to_tokens(list(range(vocab_size)))
+            specials = set(getattr(conv, "all_special_tokens", ()))
+            from .tokenizer import _bytes_to_unicode
+
+            u2b = {c: b for b, c in _bytes_to_unicode().items()}
+            # byte-level vocabs (GPT-2/Llama-3/Qwen HF tokenizers) encode
+            # space/newline as Ġ/Ċ; SentencePiece ones use ▁
+            byte_level = any(
+                t and ("Ġ" in t or "Ċ" in t)
+                for t in toks[: min(4096, vocab_size)]
+                if isinstance(t, str)
+            )
+            for i, t in enumerate(toks):
+                if not isinstance(t, str) or t in specials:
+                    continue
+                if t.startswith("<0x") and t.endswith(">") and len(t) == 6:
+                    table[i] = bytes([int(t[3:5], 16)])
+                elif byte_level:
+                    table[i] = bytes(u2b[c] for c in t if c in u2b)
+                else:
+                    table[i] = t.replace(SPIECE_SPACE, " ").encode("utf-8")
+        else:  # last resort: per-id decode (loses space markers)
+            for i in range(vocab_size):
+                try:
+                    s = tokenizer.decode([i])
+                except Exception:  # noqa: BLE001
+                    continue
+                if s:
+                    table[i] = s.encode("utf-8")
     return table
 
 

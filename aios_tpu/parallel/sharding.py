@@ -86,6 +86,14 @@ PARAM_RULES: Dict[str, P] = {
 CACHE_SPEC = P(None, "dp", None, "tp", None)
 # int8 KV-cache scales [L, slots, C, KH] ride the same placement.
 CACHE_SCALE_SPEC = P(None, "dp", None, "tp")
+# Context-sharded variant: the C axis additionally splits over sp, so one
+# slot's KV can exceed a single chip's HBM (long-context serving). XLA
+# partitions the decode attention over the sharded contraction itself —
+# per-shard partial max/denominator/accumulator with psums over sp, the
+# flash-decoding-across-chips pattern — while row writes stay local to the
+# owning shard (verified: no cache-sized all-gathers in the lowered HLO).
+CACHE_SPEC_SEQ = P(None, "dp", "sp", "tp", None)
+CACHE_SCALE_SPEC_SEQ = P(None, "dp", "sp", "tp")
 
 
 @dataclass
@@ -128,13 +136,13 @@ class ShardingPlan:
             lambda x, s: jax.device_put(jax.numpy.asarray(x), s), params, shardings
         )
 
-    def put_cache(self, cache):
-        return jax.device_put(cache, NamedSharding(self.mesh, CACHE_SPEC))
+    def put_cache(self, cache, seq_shard: bool = False):
+        spec = CACHE_SPEC_SEQ if seq_shard else CACHE_SPEC
+        return jax.device_put(cache, NamedSharding(self.mesh, spec))
 
-    def put_cache_scales(self, scales):
-        return jax.device_put(
-            scales, NamedSharding(self.mesh, CACHE_SCALE_SPEC)
-        )
+    def put_cache_scales(self, scales, seq_shard: bool = False):
+        spec = CACHE_SCALE_SPEC_SEQ if seq_shard else CACHE_SCALE_SPEC
+        return jax.device_put(scales, NamedSharding(self.mesh, spec))
 
     def ragged_attention(self, window: Optional[int], use_kernel: bool):
         """Per-device ragged decode attention under shard_map.

@@ -172,6 +172,12 @@ class ModelManager:
         self.speculative = os.environ.get(
             "AIOS_TPU_SPECULATIVE", ""
         ).lower() in ("1", "true", "on")
+        # AIOS_TPU_SEQ_SHARD_KV=1 shards every model's KV context axis over
+        # the mesh's sp axis (long-context serving: one slot's cache spans
+        # chips); needs a sharding plan with sp > 1
+        self.seq_shard_kv = sharding_plan is not None and os.environ.get(
+            "AIOS_TPU_SEQ_SHARD_KV", ""
+        ).lower() in ("1", "true", "on")
         self._lock = threading.Lock()
 
     # -- loading ------------------------------------------------------------
@@ -217,6 +223,19 @@ class ModelManager:
                     log.warning(
                         "AIOS_TPU_PAGED_KV ignored for %s: context %d is "
                         "not a multiple of 16; serving dense", name, ctx,
+                    )
+            if self.seq_shard_kv:
+                if kw:
+                    log.warning(
+                        "AIOS_TPU_SEQ_SHARD_KV ignored for %s: the paged "
+                        "KV pool is active and they are exclusive", name,
+                    )
+                elif self.plan.sp > 1 and ctx % self.plan.sp == 0:
+                    kw = dict(seq_sharded_cache=True)
+                else:
+                    log.warning(
+                        "AIOS_TPU_SEQ_SHARD_KV ignored for %s: needs "
+                        "sp > 1 dividing context %d", name, ctx,
                     )
             engine = TPUEngine(
                 cfg,

@@ -158,6 +158,51 @@ def test_token_bytes_tables():
     assert t2[0] == b"{" and t2[1] == b" a" and t2[2] is None
 
 
+def test_hf_token_bytes_keep_space_markers():
+    """The HF path must map via token STRINGS: per-id decode strips the
+    SentencePiece leading-space marker ('▁7' -> '7'), which would let the
+    automaton accept a digit continuation where the emitted text actually
+    inserts a space mid-number."""
+
+    class FakeSPFast:  # mimics transformers' API surface we rely on
+        all_special_tokens = ["<s>"]
+
+        def convert_ids_to_tokens(self, ids):
+            vocab = ["<s>", "▁7", "7", "▁", "<0x7B>"]
+            return [vocab[i] for i in ids]
+
+    class FakeHF:
+        _tok = FakeSPFast()
+        eos_id = 0
+
+        def decode(self, ids):
+            raise AssertionError("must not fall back to per-id decode")
+
+    t = jsonmode.token_bytes_table(FakeHF(), 5)
+    assert t[0] is None  # special
+    assert t[1] == b" 7"  # marker preserved
+    assert t[2] == b"7"
+    assert t[3] == b" "
+    assert t[4] == b"{"  # byte token
+
+    class FakeBLFast:
+        all_special_tokens = []
+
+        def convert_ids_to_tokens(self, ids):
+            vocab = ["Ġ7", "7", "Ċ"]
+            return [vocab[i] for i in ids]
+
+    class FakeHF2:
+        _tok = FakeBLFast()
+        eos_id = None
+
+        def decode(self, ids):
+            raise AssertionError("must not fall back to per-id decode")
+
+    t2 = jsonmode.token_bytes_table(FakeHF2(), 3)
+    assert t2[0] == b" 7" and t2[1] == b"7" and t2[2] == b"\n"
+
+
 # ---------------------------------------------------------------------------
 # constrained generation through the engine + batcher
 # ---------------------------------------------------------------------------

@@ -14,6 +14,8 @@ from aios_tpu.engine.config import TINY_TEST
 from aios_tpu.engine.engine import TPUEngine
 from aios_tpu.engine.train import make_optimizer, make_train_step
 from aios_tpu.parallel.ring_attention import ring_attention
+from jax.sharding import PartitionSpec as P
+
 from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
 
 
@@ -381,3 +383,87 @@ def test_pp_training_reduces_loss():
         state, m = step_fn(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses  # memorizes the fixed batch
+
+
+# ---------------------------------------------------------------------------
+# context-sharded KV cache (long-context serving over sp)
+# ---------------------------------------------------------------------------
+
+
+def test_seq_sharded_cache_decode_matches_single_device(cpu_devices):
+    """KV sharded along the context axis over sp (CACHE_SPEC_SEQ): one
+    slot's cache spans chips, outputs bit-match the unsharded engine.
+    XLA partitions the attention softmax over the sharded contraction
+    (partial stats + psum over sp) — no cache-sized all-gathers."""
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    plan = ShardingPlan(build_mesh(8, dp=2, sp=2, tp=2))
+    ref = TPUEngine(cfg, params, num_slots=4, max_context=64,
+                    cache_dtype=jnp.float32)
+    eng = TPUEngine(cfg, params, num_slots=4, max_context=64,
+                    cache_dtype=jnp.float32, shardings=plan,
+                    seq_sharded_cache=True)
+    try:
+        assert str(eng.state["k"].sharding.spec) == str(
+            P(None, "dp", "sp", "tp", None)
+        )
+        prompt = [1, 2, 3, 4, 5] * 4
+        assert eng.generate(prompt, max_new_tokens=16, temperature=0.0) == \
+            ref.generate(prompt, max_new_tokens=16, temperature=0.0)
+        for s in range(4):
+            eng.prefill(s, list(range(1, 10 + s)), temperature=0.0)
+            ref.prefill(s, list(range(1, 10 + s)), temperature=0.0)
+        assert (eng.step(5) == ref.step(5)).all()
+    finally:
+        eng.close()
+        ref.close()
+
+
+def test_seq_sharded_cache_int8_kv(cpu_devices):
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    plan = ShardingPlan(build_mesh(8, dp=2, sp=2, tp=2))
+    eng = TPUEngine(cfg, params, num_slots=2, max_context=64,
+                    cache_dtype=jnp.int8, shardings=plan,
+                    seq_sharded_cache=True)
+    ref = TPUEngine(cfg, params, num_slots=2, max_context=64,
+                    cache_dtype=jnp.int8)
+    try:
+        assert eng.prefill(0, [1, 2, 3, 4], temperature=0.0) == \
+            ref.prefill(0, [1, 2, 3, 4], temperature=0.0)
+        assert (eng.step(3) == ref.step(3)).all()
+    finally:
+        eng.close()
+        ref.close()
+
+
+def test_seq_sharded_cache_guards(cpu_devices):
+    import jax.numpy as jnp
+    import pytest
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="sharding plan"):
+        TPUEngine(cfg, params, num_slots=2, max_context=64,
+                  cache_dtype=jnp.float32, seq_sharded_cache=True)
+    plan = ShardingPlan(build_mesh(8, dp=2, sp=2, tp=2))
+    with pytest.raises(ValueError, match="paged"):
+        TPUEngine(cfg, params, num_slots=2, max_context=64,
+                  cache_dtype=jnp.float32, shardings=plan,
+                  seq_sharded_cache=True, paged_pool_rows=128)
