@@ -507,6 +507,35 @@ def test_ep_sharded_engine_decode_matches_single_device(cpu_devices):
         ref.close()
 
 
+@pytest.mark.parametrize("seq_parallel", ["ring", "ulysses"])
+def test_moe_train_composes_with_sequence_parallel(cpu_devices, seq_parallel):
+    """MoE (ep) x sequence parallelism (sp) x TP in one train step: the
+    expert FFN is orthogonal to the attention sharding, so ring/Ulysses
+    and the ep psum compose on the same mesh."""
+    from aios_tpu.engine.train import make_optimizer, make_train_step
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    cfg = TINY_MOE  # 4 heads, 2 kv heads: ulysses sp=2 divides both
+    mesh = build_mesh(8, dp=1, sp=2, ep=2, tp=2)
+    plan = ShardingPlan(mesh)
+    plan.validate(cfg, num_slots=2)
+    params = plan.put_params(
+        M.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    )
+    init_state, train_step = make_train_step(
+        cfg, mesh, optimizer=make_optimizer(warmup_steps=1, total_steps=10),
+        seq_parallel=seq_parallel,
+    )
+    state = init_state(params)
+    batch = {
+        "tokens": jnp.asarray(_tokens(cfg, batch=2, seq=32, seed=31)),
+        "loss_mask": jnp.ones((2, 32), jnp.float32),
+    }
+    state, metrics = jax.jit(train_step)(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert 0.9 < float(metrics["moe_aux"]) < 4.0
+
+
 def test_ep_requires_moe_config():
     from aios_tpu.engine.config import TINY_TEST
     from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
