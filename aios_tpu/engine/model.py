@@ -435,21 +435,41 @@ def _ragged_min_c() -> int:
         return 2048
 
 
+def _int8_ragged_enabled() -> bool:
+    """Gate for the int8-KV ragged decode kernel (read at trace time):
+    interpret-mode-verified, but OFF by default until its crossover is
+    measured on a real chip (the dequantizing XLA path is the baseline)."""
+    import os
+
+    return os.environ.get("AIOS_TPU_INT8_RAGGED", "").lower() in (
+        "1", "true", "on",
+    )
+
+
 def _use_ragged_kernel(
-    kernels: Optional[bool], C: int, cfg: ModelConfig, quant_cache: bool
+    kernels: Optional[bool],
+    C: int,
+    cfg: ModelConfig,
+    quant_cache: bool,
+    quant_kernel_ok: bool = False,
 ) -> bool:
     """The ragged-attention crossover, shared by decode_step and
     verify_step: the kernel's DMA-only-valid-rows win beats its per-layer
     launch cost either on a long cache outright (>= _ragged_min_c rows,
     the TinyLlama-measured crossover) or on a large-model cache whose
     C x (KH x D) slab is >= 1 MiB of rows per slot (Mistral-7B at 1k rows
-    measures +11% whole-step throughput on v5e). The kernels read bf16
-    caches only, so int8-KV paths stay on XLA."""
+    measures +11% whole-step throughput on v5e).
+
+    ``quant_kernel_ok`` — whether the CALLER has an int8-capable kernel
+    for this path: decode_step passes _int8_ragged_enabled() (its kernel
+    ladder includes ops.decode_attention_int8, env-gated until measured);
+    verify/multiquery and the paged kernel are bf16-only, so their int8-KV
+    paths stay on XLA."""
     kv_row = cfg.num_kv_heads * cfg.head_dim
     return (
         _use_kernels(kernels)
         and (C >= _ragged_min_c() or C * kv_row >= 1 << 20)
-        and not quant_cache
+        and (not quant_cache or quant_kernel_ok)
     )
 
 
@@ -645,6 +665,16 @@ def decode_step(
     use_kernel = attn_impl is None and _use_ragged_kernel(
         kernels, C, cfg, quant_cache
     )
+    # int8-KV ragged kernel: scales fold into the score/value dots so the
+    # cache streams as int8 (half the bytes) AND only valid rows DMA
+    use_int8_kernel = (
+        attn_impl is None
+        and quant_cache
+        and _use_ragged_kernel(
+            kernels, C, cfg, quant_cache,
+            quant_kernel_ok=_int8_ragged_enabled(),
+        )
+    )
     if active is None:
         write_rows = lengths
         read_lengths = lengths
@@ -658,7 +688,7 @@ def decode_step(
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
 
     batch_idx = jnp.arange(B)
-    if use_kernel or attn_impl is not None:
+    if use_kernel or use_int8_kernel or attn_impl is not None:
         mask = None
     else:
         cols = jnp.arange(C)[None, :]
@@ -683,12 +713,18 @@ def decode_step(
             v_l = v_l.at[batch_idx, write_rows].set(vq)
             k_s = k_s.at[batch_idx, write_rows].set(ks_new)
             v_s = v_s.at[batch_idx, write_rows].set(vs_new)
-            attn = gqa_attention(
-                q,
-                dequantize_kv(k_l, k_s, q.dtype),
-                dequantize_kv(v_l, v_s, q.dtype),
-                mask,
-            )
+            if use_int8_kernel:
+                attn = ops.decode_attention_int8(
+                    q[:, 0], k_l, v_l, k_s, v_s, read_lengths,
+                    window=cfg.sliding_window,
+                )[:, None]
+            else:
+                attn = gqa_attention(
+                    q,
+                    dequantize_kv(k_l, k_s, q.dtype),
+                    dequantize_kv(v_l, v_s, q.dtype),
+                    mask,
+                )
         else:
             k_l = k_l.at[batch_idx, write_rows].set(k_new[:, 0].astype(k_l.dtype))
             v_l = v_l.at[batch_idx, write_rows].set(v_new[:, 0].astype(v_l.dtype))

@@ -28,7 +28,12 @@ import os
 
 import jax
 
-from .decode_attention import decode_attention, decode_attention_reference
+from .decode_attention import (
+    decode_attention,
+    decode_attention_int8,
+    decode_attention_int8_reference,
+    decode_attention_reference,
+)
 from .flash_attention import flash_attention, flash_attention_reference
 from .paged_attention import (
     gather_pages,
@@ -50,6 +55,8 @@ __all__ = [
     "flash_attention",
     "flash_attention_reference",
     "decode_attention",
+    "decode_attention_int8",
+    "decode_attention_int8_reference",
     "decode_attention_reference",
     "paged_decode_attention",
     "paged_decode_attention_reference",

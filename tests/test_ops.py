@@ -271,3 +271,71 @@ def test_int8_kv_cache_slot_isolation():
                      cache_dtype=jnp.int8)
     out2 = eng2.generate([3, 1, 4], max_new_tokens=6, temperature=0.0, slot=0)
     assert out == out2
+
+
+# ---------------------------------------------------------------------------
+# int8-KV ragged decode kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [None, 16])
+@pytest.mark.parametrize("lengths", [[0, 13, 31, 63], [63, 63, 7, 1]])
+def test_decode_attention_int8_parity(window, lengths):
+    from aios_tpu.ops import (
+        decode_attention_int8,
+        decode_attention_int8_reference,
+    )
+
+    rng = np.random.default_rng(5)
+    B, H, KH, D, C = 4, 8, 2, 16, 64
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.integers(-127, 128, (B, C, KH, D)), jnp.int8)
+    v = jnp.asarray(rng.integers(-127, 128, (B, C, KH, D)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, (B, C, KH)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, (B, C, KH)), jnp.float32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    got = decode_attention_int8(
+        q, k, v, ks, vs, lens, window=window, block_kv=16, interpret=True
+    )
+    ref = decode_attention_int8_reference(q, k, v, ks, vs, lens, window=window)
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_decode_step_int8_ragged_wiring(monkeypatch):
+    """AIOS_TPU_INT8_RAGGED=1 routes the int8-KV decode through the ragged
+    kernel (reference body stands in on CPU); outputs match the
+    dequantizing XLA path."""
+    import aios_tpu.ops as ops_mod
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    lens = jnp.asarray([5, 0, 9, 2], jnp.int32)
+    k, v = M.init_kv_cache(cfg, 4, 32, jnp.int8)
+    scales = M.init_kv_scales(cfg, 4, 32)
+
+    ref, _, _, _ = M.decode_step(
+        params, cfg, toks, lens, k, v, kernels=False,
+        cache_scales=scales,
+    )
+
+    called = {}
+
+    def fake_kernel(q, k_l, v_l, k_s, v_s, lengths, window=None):
+        called["hit"] = True
+        return ops_mod.decode_attention_int8_reference(
+            q, k_l, v_l, k_s, v_s, lengths, window=window
+        )
+
+    monkeypatch.setenv("AIOS_TPU_INT8_RAGGED", "1")
+    monkeypatch.setenv("AIOS_TPU_RAGGED_MIN_C", "1")  # force the crossover
+    monkeypatch.setattr(ops_mod, "decode_attention_int8", fake_kernel)
+    got, _, _, _ = M.decode_step(
+        params, cfg, toks, lens, k, v, kernels=True,
+        cache_scales=scales,
+    )
+    assert called.get("hit")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
