@@ -1100,6 +1100,7 @@ class TPUEngine:
         # agents' TTFT)
         step_sizes: Tuple[int, ...] = (1, 2, 8, 16),
         prefill_chunk: Optional[int] = None,  # None -> prefill_chunk_default
+        masked_step: bool = False,  # also compile the grammar-masked step
     ) -> None:
         """Pre-compile decode + prefill buckets (LoadModel readiness gate —
         the reference's /health polling equivalent, model_manager.rs:222-263;
@@ -1119,6 +1120,12 @@ class TPUEngine:
         prefix_index, self.prefix_index = self.prefix_index, None
         try:
             self._warmup_graphs(step_sizes, prefill_chunk)
+            if masked_step:  # json-mode deployments dispatch step_masked
+                self.step_masked(
+                    np.zeros(
+                        (self.num_slots, self.cfg.vocab_size), np.float32
+                    )
+                )
         finally:
             self.prefix_index = prefix_index
         if self.prefix_index is not None:

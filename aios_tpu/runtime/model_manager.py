@@ -249,7 +249,11 @@ class ModelManager:
             )
             del params
             if self.warm_compile:
-                engine.warmup()
+                # json-mode deployments dispatch the grammar-masked step;
+                # compile it behind the readiness gate too
+                from .service import json_mode_forced
+
+                engine.warmup(masked_step=json_mode_forced())
             batcher = ContinuousBatcher(
                 engine, speculative=self.speculative, tokenizer=tokenizer
             )

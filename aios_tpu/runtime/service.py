@@ -41,6 +41,16 @@ DEFAULT_TEMPERATURE = 0.7
 DEFAULT_TOP_P = 0.95
 
 
+def json_mode_forced() -> bool:
+    """AIOS_TPU_JSON_MODE=force: every non-streaming Infer is grammar-
+    constrained to one JSON object (the reference's response_format
+    behavior, inference.rs:114-122). Single accepted-value set shared by
+    the per-request check and the model manager's warmup gate."""
+    return os.environ.get("AIOS_TPU_JSON_MODE", "").lower() in (
+        "force", "1", "on",
+    )
+
+
 class RuntimeService(AIRuntimeServicer):
     def __init__(self, manager: Optional[ModelManager] = None):
         self.manager = manager or ModelManager()
@@ -159,11 +169,7 @@ class RuntimeService(AIRuntimeServicer):
         # the blanket force would garble plain-text think() flows that the
         # reference only gets away with because its prompts all demand
         # JSON; AIOS_TPU_JSON_MODE=force restores exact reference behavior.
-        json_mode = (
-            not streaming
-            and os.environ.get("AIOS_TPU_JSON_MODE", "").lower()
-            in ("force", "1", "on")
-        )
+        json_mode = not streaming and json_mode_forced()
         req = Request(
             prompt_ids=prompt_ids,
             max_tokens=request.max_tokens or DEFAULT_MAX_TOKENS,
