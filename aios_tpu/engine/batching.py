@@ -46,6 +46,11 @@ class Request:
     # (the reference's non-streaming response_format=json_object behavior,
     # inference.rs:114-122, realized with logit masks instead of GBNF)
     json_mode: bool = False
+    # structured outputs: output restricted to the exact SHAPE of this
+    # schema (engine/jsonschema.py subset — known/required keys, enums,
+    # typed scalars, nested/any subtrees). Wins over json_mode when both
+    # are set (it is the stricter guarantee).
+    json_schema: Optional[dict] = None
 
 
 @dataclass
@@ -103,6 +108,11 @@ class ContinuousBatcher:
         self.tokenizer = tokenizer
         self._json_masks = None  # lazy jsonmode.JsonMaskCache
         self._json_masks_lock = threading.Lock()
+        self._token_table = None  # shared token->bytes table
+        self._byte_matrix = None  # shared (mat, lens) across mask caches
+        from collections import OrderedDict
+
+        self._schema_caches: "OrderedDict[str, object]" = OrderedDict()
         self.chunk_steps = chunk_steps
         self.admit_chunk_steps = admit_chunk_steps
         # Speculative dispatches (engine.spec_step) emit 1..draft_len+1
@@ -168,6 +178,21 @@ class ContinuousBatcher:
 
     # -- public API ---------------------------------------------------------
 
+    def _token_bytes(self):
+        """Shared token->bytes table (built once; caller holds the lock)."""
+        if self._token_table is None:
+            from . import jsonmode
+
+            if self.tokenizer is None:
+                raise ValueError(
+                    "json_mode/json_schema requires the batcher to know "
+                    "the tokenizer"
+                )
+            self._token_table = jsonmode.token_bytes_table(
+                self.tokenizer, self.engine.cfg.vocab_size
+            )
+        return self._token_table
+
     def _json_mask_cache(self):
         """Lazily build the per-model mask cache (one vocab walk; locked —
         concurrent first json_mode submits from the gRPC pool must share
@@ -176,17 +201,49 @@ class ContinuousBatcher:
             if self._json_masks is None:
                 from . import jsonmode
 
-                if self.tokenizer is None:
-                    raise ValueError(
-                        "json_mode requires the batcher to know the tokenizer"
-                    )
-                table = jsonmode.token_bytes_table(
-                    self.tokenizer, self.engine.cfg.vocab_size
-                )
                 self._json_masks = jsonmode.JsonMaskCache(
-                    table, getattr(self.tokenizer, "eos_id", None)
+                    self._token_bytes(),
+                    getattr(self.tokenizer, "eos_id", None),
                 )
             return self._json_masks
+
+    def _schema_mask_cache(self, schema: dict):
+        """Per-(model, schema) mask cache; compiled once, shared by every
+        request carrying the same schema (the autonomy loop resends its
+        tool_calls schema on every reasoning round). LRU-bounded — the
+        schema string is CLIENT input, and every cache pins per-state mask
+        rows — with the vocab byte matrix built once and shared."""
+        from . import jsonschema
+
+        key = jsonschema.schema_cache_key(schema)
+        with self._json_masks_lock:
+            cache = self._schema_caches.get(key)
+            if cache is not None:
+                self._schema_caches.move_to_end(key)
+                return cache
+            table = self._token_bytes()
+            if self._byte_matrix is None:
+                base = self._json_masks
+                if base is not None:
+                    self._byte_matrix = (base._byte_mat, base._byte_lens)
+            cache = jsonschema.SchemaMaskCache(
+                table,
+                getattr(self.tokenizer, "eos_id", None),
+                schema,
+                byte_matrix=self._byte_matrix,
+            )
+            if self._byte_matrix is None:
+                self._byte_matrix = (cache._byte_mat, cache._byte_lens)
+            if cache.start_token_id is None:
+                raise ValueError(
+                    "json_schema root must be an object, array, or "
+                    "any (scalar roots have no forced opener; wrap "
+                    "them in an object)"
+                )
+            while len(self._schema_caches) >= 16:
+                self._schema_caches.popitem(last=False)
+            self._schema_caches[key] = cache
+            return cache
 
     def submit(self, req: Request) -> RequestHandle:
         if not req.prompt_ids:
@@ -196,11 +253,25 @@ class ContinuousBatcher:
         if not req.request_id:
             req.request_id = f"req-{next(self._ids)}"
         live = _Live(req=req, slot=-1, submitted_at=time.monotonic())
-        if req.json_mode:
+        if req.json_schema is not None:
             from . import jsonmode
 
             # built on the CALLER's thread (fail fast + keep the vocab
-            # walk off the scheduler thread)
+            # walk / schema compile off the scheduler thread)
+            cache = self._schema_mask_cache(req.json_schema)
+            min_bytes = cache._distance(cache.start())
+            max_tok_bytes = cache._byte_mat.shape[1]
+            if req.max_tokens * max_tok_bytes < min_bytes:
+                # even all-longest tokens cannot carry the schema's minimal
+                # completion: the output could only truncate
+                raise ValueError(
+                    f"max_tokens={req.max_tokens} cannot fit the schema's "
+                    f"minimal completion ({min_bytes} bytes)"
+                )
+            live.constraint = jsonmode.JsonConstraint(cache)
+        elif req.json_mode:
+            from . import jsonmode
+
             live.constraint = jsonmode.JsonConstraint(self._json_mask_cache())
         with self._qlock:
             self._waiting.append(live)
@@ -451,8 +522,8 @@ class ContinuousBatcher:
             # assembles on device — no per-step PCIe traffic.
             import jax.numpy as jnp
 
-            cache = self._json_mask_cache()
             by_slot = dict(constrained)
+            zeros = constrained[0][1].constraint.cache.zeros_row()
             rows = [
                 (
                     by_slot[s_].constraint.device_mask(
@@ -460,7 +531,7 @@ class ContinuousBatcher:
                         - by_slot[s_].produced
                     )
                     if s_ in by_slot
-                    else cache.zeros_row()
+                    else zeros
                 )
                 for s_ in range(self.engine.num_slots)
             ]

@@ -306,23 +306,40 @@ def token_bytes_table(tokenizer, vocab_size: int) -> List[Optional[bytes]]:
 
 
 def distance_to_terminal(state: State) -> int:
-    """Approximate tokens needed to reach a terminal state (one closer per
-    open container plus what the in-flight construct needs: a mid-key
-    string must still close, take its colon AND produce a value). Drives
-    the budget-aware closing mask; multi-character tokens can beat this,
-    so callers keep a safety margin on top."""
+    """Minimal BYTES to reach a terminal state — an upper bound on the
+    tokens a completion needs (every token carries >= 1 byte). The budget
+    feasibility gate and the closing walk both rely on this being exact:
+    an underestimate admits tokens whose completion cannot fit the
+    remaining budget (observed: truncation inside a \\uXXXX escape)."""
     phase, stack = state[0], state[1]
-    d = len(stack)
+    d = len(stack)  # one closer byte per open container
     if phase == "E":
         return d
     if phase == "N":
         return d if state[2] in _NUM_DONE else d + 1
-    if phase in ("S", "X", "U") and state[2]:  # inside a KEY string
-        return d + 3  # close quote, colon, minimal value
+    if phase in ("S", "X", "U"):
+        is_key = state[2]
+        # finish the string itself...
+        if phase == "S":
+            extra = 1  # closing quote
+        elif phase == "X":
+            extra = 2  # escape char + closing quote
+        else:  # U: remaining hex digits + closing quote
+            extra = (4 - state[3]) + 1
+        # ...keys additionally need ':' and a minimal value ('0')
+        return d + extra + (2 if is_key else 0)
     if phase == "C":
-        return d + 2  # colon, minimal value
+        return d + 2  # ':' + minimal value
     if phase == "K1":
-        return d + 4  # key open+close, colon, minimal value
+        return d + 4  # '""' + ':' + minimal value (empty key is legal)
+    if phase == "K":
+        return d  # '}' closes (counted in the stack)
+    if phase == "L":
+        return d + len(state[2]) - state[3]
+    if phase == "V0":
+        return d + 2  # '{}'
+    if phase in ("V", "A"):
+        return d + (0 if phase == "A" else 1)  # A may close; V needs '0'
     return d + 1
 
 
@@ -335,6 +352,7 @@ class JsonMaskCache:
         eos_id: Optional[int],
         require_object: bool = True,
         max_depth: int = 16,
+        byte_matrix=None,  # prebuilt (mat, lens) shared across caches
     ) -> None:
         self.token_bytes = token_bytes
         self.vocab_size = len(token_bytes)
@@ -343,22 +361,27 @@ class JsonMaskCache:
         self.max_depth = max_depth
         self._masks: Dict[State, np.ndarray] = {}
         self._closing: Dict[State, np.ndarray] = {}
-        self._dev: Dict[int, object] = {}  # id(np row) -> device array
+        self._dist_rows: Dict[State, np.ndarray] = {}
+        self._dev: Dict[int, object] = {}  # id(np row) -> (row, device)
+        self._row_state: object = None  # state of the last mask_row call
         # vectorized-walk precompute: padded byte matrix + global automaton
         # state registry (row construction is numpy over the whole vocab
         # per byte position, not a python loop per token — a fresh state's
         # row costs ~ms even on 150k vocabs, cheap enough for the
         # scheduler thread)
-        lens = np.array(
-            [len(tb) if tb else 0 for tb in token_bytes], np.int32
-        )
-        lmax = int(lens.max()) if len(lens) else 1
-        mat = np.zeros((self.vocab_size, max(lmax, 1)), np.uint8)
-        for i, tb in enumerate(token_bytes):
-            if tb:
-                mat[i, : len(tb)] = np.frombuffer(tb, np.uint8)
-        self._byte_mat = mat
-        self._byte_lens = lens
+        if byte_matrix is not None:
+            self._byte_mat, self._byte_lens = byte_matrix
+        else:
+            lens = np.array(
+                [len(tb) if tb else 0 for tb in token_bytes], np.int32
+            )
+            lmax = int(lens.max()) if len(lens) else 1
+            mat = np.zeros((self.vocab_size, max(lmax, 1)), np.uint8)
+            for i, tb in enumerate(token_bytes):
+                if tb:
+                    mat[i, : len(tb)] = np.frombuffer(tb, np.uint8)
+            self._byte_mat = mat
+            self._byte_lens = lens
         self._states: List[State] = []
         self._sindex: Dict[State, int] = {}
         self._dists: List[int] = []
@@ -370,8 +393,28 @@ class JsonMaskCache:
                 self.start_token_id = i
                 break
 
+    # -- grammar hooks (override for other grammars, e.g. jsonschema.py) ---
+
     def start(self) -> State:
         return start_state(self.require_object)
+
+    def _transition(self, state: State, b: int) -> Optional[State]:
+        return next_state(state, b, self.max_depth)
+
+    def _terminal(self, state: State) -> bool:
+        return is_terminal(state)
+
+    def _distance(self, state: State) -> int:
+        return distance_to_terminal(state)
+
+    def run(self, state: State, data: bytes) -> Optional[State]:
+        for byte in data:
+            state = self._transition(state, byte)
+            if state is None:
+                return None
+        return state
+
+    # ----------------------------------------------------------------------
 
     def _state_idx(self, state: State) -> int:
         i = self._sindex.get(state)
@@ -379,7 +422,7 @@ class JsonMaskCache:
             i = len(self._states)
             self._states.append(state)
             self._sindex[state] = i
-            self._dists.append(distance_to_terminal(state))
+            self._dists.append(self._distance(state))
         return i
 
     def _walk_vocab(self, state: State) -> np.ndarray:
@@ -400,7 +443,7 @@ class JsonMaskCache:
                 si, b = divmod(int(k), 256)
                 t = self._trans.get((si, b))
                 if t is None:
-                    ns = next_state(self._states[si], b, self.max_depth)
+                    ns = self._transition(self._states[si], b)
                     t = -1 if ns is None else self._state_idx(ns)
                     self._trans[(si, b)] = t
                 dest[j] = t
@@ -415,7 +458,7 @@ class JsonMaskCache:
             return row
         final = self._walk_vocab(state)
         row = np.where(final >= 0, np.float32(0.0), np.float32(NEG_INF))
-        if self.eos_id is not None and is_terminal(state):
+        if self.eos_id is not None and self._terminal(state):
             row[self.eos_id] = 0.0
         if not (row == 0.0).any():
             # dead end (can't happen from reachable states — whitespace and
@@ -435,35 +478,63 @@ class JsonMaskCache:
         row = self._closing.get(state)
         if row is not None:
             return row
-        if self.eos_id is not None and is_terminal(state):
+        if self.eos_id is not None and self._terminal(state):
             row = np.full((self.vocab_size,), NEG_INF, np.float32)
             row[self.eos_id] = 0.0
             self._closing[state] = row
             return row
-        final = self._walk_vocab(state)
-        valid = final >= 0
+        fd = self.dist_row(state)
         row = np.full((self.vocab_size,), NEG_INF, np.float32)
-        if valid.any():
-            dists = np.asarray(self._dists, np.int32)
-            fd = np.where(valid, dists[np.maximum(final, 0)], np.iinfo(np.int32).max)
+        if fd.min() < np.iinfo(np.int32).max:
             row[fd == fd.min()] = 0.0
         else:
             row[:] = 0.0  # same fail-open rule as mask_row
         self._closing[state] = row
         return row
 
+    def dist_row(self, state: State) -> np.ndarray:
+        """int32 [vocab]: distance-to-terminal of the state each token
+        leads to (INT32_MAX for out-of-grammar tokens). The budget
+        feasibility gate reads this; cached per state."""
+        cached = self._dist_rows.get(state)
+        if cached is not None:
+            return cached
+        final = self._walk_vocab(state)
+        valid = final >= 0
+        dists = np.asarray(self._dists, np.int32)
+        fd = np.where(
+            valid, dists[np.maximum(final, 0)], np.iinfo(np.int32).max
+        ).astype(np.int32)
+        self._dist_rows[state] = fd
+        return fd
+
     def device_row(self, row: np.ndarray):
-        """Device-resident copy of a cached mask row — the per-step [slots,
-        vocab] mask is then assembled ON DEVICE (jnp.stack of cached rows),
-        so steady-state constrained decoding moves no mask bytes over PCIe."""
+        """Device-resident copy of a mask row — the per-step [slots, vocab]
+        mask is then assembled ON DEVICE (jnp.stack of cached rows), so
+        steady-state constrained decoding moves no mask bytes over PCIe.
+
+        The cache entry PINS the numpy row (id()-keyed lookups are only
+        sound while the array is alive — a temporary row's recycled id
+        must never alias a stale device mask) and the dict is bounded:
+        budget-hybrid rows near the end of a generation are fresh arrays,
+        one per step."""
         import jax.numpy as jnp
 
         key = id(row)
         got = self._dev.get(key)
-        if got is None:
-            got = jnp.asarray(row)
-            self._dev[key] = got
-        return got
+        if got is not None and got[0] is row:
+            return got[1]
+        dev = jnp.asarray(row)
+        # only PERSISTENT rows (the per-state entries of _masks/_closing)
+        # earn a cache slot — budget-hybrid rows are one-shot temporaries
+        # and would pin host+device memory until the wholesale clear
+        if row is self._masks.get(self._row_state) or row is (
+            self._closing.get(self._row_state)
+        ):
+            if len(self._dev) > 512:
+                self._dev.clear()
+            self._dev[key] = (row, dev)
+        return dev
 
     def zeros_row(self):
         import jax.numpy as jnp
@@ -484,14 +555,35 @@ class JsonConstraint:
         self.failed = False
 
     def mask_row(self, remaining: Optional[int] = None) -> np.ndarray:
-        """Mask for the next step; with ``remaining`` (token budget left),
-        switches to the closing mask when the budget approaches the
-        minimum tokens needed to finish, so the object completes."""
-        if remaining is not None and remaining <= (
-            distance_to_terminal(self.state) + 4
-        ):
+        """Mask for the next step. With ``remaining`` (token budget left),
+        tokens are additionally gated on BUDGET FEASIBILITY: a token is
+        allowed only if the state it leads to can still complete within
+        remaining-1 more tokens (distances are bytes, an upper bound on
+        tokens, so feasibility is conservative). By induction the output
+        always completes once the budget ever covered the current
+        distance; a budget infeasible from the start degrades to the
+        pure min-distance closing walk."""
+        self.cache._row_state = self.state  # device_row cacheability hint
+        base = self.cache.mask_row(self.state)
+        if remaining is None:
+            return base
+        fd = self.cache.dist_row(self.state)
+        finite = fd[fd < np.iinfo(np.int32).max]
+        if finite.size and int(finite.min()) > remaining - 1:
+            # nothing fits: close as fast as possible (margin was blown
+            # before the constraint started, e.g. max_tokens < minimal
+            # completion)
             return self.cache.closing_row(self.state)
-        return self.cache.mask_row(self.state)
+        if finite.size and int(finite.max()) <= remaining - 1:
+            return base  # every in-grammar token fits: cached row as-is
+        row = np.where(
+            (base == 0.0) & (fd <= remaining - 1),
+            np.float32(0.0),
+            np.float32(NEG_INF),
+        )
+        if self.cache.eos_id is not None and self.cache._terminal(self.state):
+            row[self.cache.eos_id] = 0.0
+        return row
 
     def device_mask(self, remaining: Optional[int] = None):
         """Device-resident mask row for the next step (no per-step PCIe)."""
@@ -512,7 +604,7 @@ class JsonConstraint:
         if not tb:
             self.failed = True
             return
-        nxt = run_bytes(self.state, tb, self.cache.max_depth)
+        nxt = self.cache.run(self.state, tb)
         if nxt is None:
             self.failed = True
             return
@@ -520,4 +612,4 @@ class JsonConstraint:
 
     @property
     def satisfied(self) -> bool:
-        return not self.failed and is_terminal(self.state)
+        return not self.failed and self.cache._terminal(self.state)

@@ -120,7 +120,7 @@ class RuntimeService(AIRuntimeServicer):
         m = self._resolve_model(request, context)
         if m is None:
             return runtime_pb2.InferResponse()
-        handle, n_prompt = self._submit(m, request)
+        handle, n_prompt = self._submit(m, request, context=context)
         token_ids = [t for t in handle if t != m.tokenizer.eos_id]
         text = m.tokenizer.decode(token_ids)
         latency_ms = int((time.time() - t0) * 1000)
@@ -135,7 +135,9 @@ class RuntimeService(AIRuntimeServicer):
         m = self._resolve_model(request, context)
         if m is None:
             return
-        handle, _ = self._submit(m, request, streaming=True)
+        handle, _ = self._submit(
+            m, request, streaming=True, context=context
+        )
         emitted = ""
         ids = []
         for tok in handle:
@@ -155,13 +157,33 @@ class RuntimeService(AIRuntimeServicer):
 
     # -- helpers ------------------------------------------------------------
 
-    def _submit(self, m: ManagedModel, request, streaming: bool = False):
+    def _submit(self, m: ManagedModel, request, streaming: bool = False,
+                context=None):
         m.touch()
         prompt_text = render_chat(
             m.config.name, request.prompt, request.system_prompt
         )
         prompt_ids = m.tokenizer.encode(prompt_text)
         stop = (m.tokenizer.eos_id,) if m.tokenizer.eos_id is not None else ()
+        # TPU extension field: grammar-guided structured output (the schema
+        # subset of engine/jsonschema.py); malformed input is the caller's
+        # error, surfaced as INVALID_ARGUMENT
+        schema = None
+        raw_schema = getattr(request, "json_schema", "")
+        if raw_schema:
+            import json as _json
+
+            try:
+                schema = _json.loads(raw_schema)
+                if not isinstance(schema, dict):
+                    raise ValueError("schema must be a JSON object")
+            except ValueError as e:
+                if context is not None:
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"invalid json_schema: {e}",
+                    )
+                raise
         # The reference forces response_format=json_object on every
         # NON-streaming local inference (inference.rs:114-122, enforced by
         # llama-server's grammar engine). The TPU equivalent is logit-mask
@@ -169,7 +191,9 @@ class RuntimeService(AIRuntimeServicer):
         # the blanket force would garble plain-text think() flows that the
         # reference only gets away with because its prompts all demand
         # JSON; AIOS_TPU_JSON_MODE=force restores exact reference behavior.
-        json_mode = not streaming and json_mode_forced()
+        json_mode = (
+            schema is None and not streaming and json_mode_forced()
+        )
         req = Request(
             prompt_ids=prompt_ids,
             max_tokens=request.max_tokens or DEFAULT_MAX_TOKENS,
@@ -182,8 +206,18 @@ class RuntimeService(AIRuntimeServicer):
             stop_ids=stop,
             request_id=request.task_id or "",
             json_mode=json_mode,
+            json_schema=schema,
         )
-        return m.batcher.submit(req), len(prompt_ids)
+        try:
+            return m.batcher.submit(req), len(prompt_ids)
+        except ValueError as e:
+            # unsupported schema constructs / scalar roots fail fast
+            if context is not None and schema is not None:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unsupported json_schema: {e}",
+                )
+            raise
 
     def _resolve_model(self, request, context) -> Optional[ManagedModel]:
         """explicit name -> level ladder -> any ready -> gRPC error."""

@@ -1,0 +1,337 @@
+"""JSON-Schema-guided decoding (engine/jsonschema.py): structured outputs.
+
+The reference's autonomy loop re-prompts through JSON-repair rounds when
+tool_calls don't parse (autonomy.rs:290-328); schema-guided masks make the
+first round parse by construction. These tests cover the compiled automaton
+(accept/reject), the budget-feasibility gate (outputs ALWAYS complete when
+the budget can fit them), and the gRPC surface (wire-compatible
+InferRequest.json_schema extension field).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu.engine import jsonmode, jsonschema
+from aios_tpu.engine import model as M
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.engine.jsonmode import JsonConstraint
+from aios_tpu.engine.tokenizer import ByteTokenizer
+
+TOOL_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "tool_calls": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "tool": {
+                        "type": "string",
+                        "enum": ["fs.read", "fs.write", "net.ping"],
+                    },
+                    "args": {},
+                },
+                "required": ["tool"],
+            },
+        },
+        "done": {"type": "boolean"},
+        "count": {"type": "integer"},
+    },
+    "required": ["done"],
+}
+
+
+def _machine(schema):
+    table, root = jsonschema.compile_schema(schema)
+    return jsonschema.SchemaMachine(table, root)
+
+
+def _run(m, text):
+    st = m.start()
+    for b in text.encode():
+        st = m.step(st, b)
+        if st is None:
+            return None
+    return st
+
+
+ACCEPT = [
+    '{"done": true}',
+    '{"tool_calls": [], "done": false}',
+    '{"tool_calls": [{"tool": "fs.read"}], "done": true}',
+    '{"tool_calls": [{"tool": "net.ping", "args": {"host": "8.8.8.8", '
+    '"n": [1, 2.5]}}], "done": true}',
+    '{"count": -42, "done": false}',
+    '{ "done"\t:\ntrue }',
+]
+
+REJECT = [
+    "{}",  # missing required
+    '{"done": 1}',  # wrong type
+    '{"done": true, "done": false}',  # duplicate key
+    '{"unknown": 1, "done": true}',  # unknown key
+    '{"tool_calls": [{"tool": "bad"}], "done": true}',  # enum violation
+    '{"tool_calls": [{"args": {}}], "done": true}',  # missing inner required
+    '{"count": 1.5, "done": true}',  # integer violated
+    '{"count": 01, "done": true}',  # leading zero
+    '[{"done": true}]',  # root must be the object
+]
+
+
+@pytest.mark.parametrize("text", ACCEPT)
+def test_schema_accepts(text):
+    m = _machine(TOOL_SCHEMA)
+    st = _run(m, text)
+    assert st is not None and m.terminal(st), text
+
+
+@pytest.mark.parametrize("text", REJECT)
+def test_schema_rejects(text):
+    m = _machine(TOOL_SCHEMA)
+    st = _run(m, text)
+    assert st is None or not m.terminal(st), text
+
+
+def test_compile_rejects_unsupported():
+    for bad in (
+        {"type": "object", "properties": {"a": {"type": "string"}},
+         "required": ["b"]},
+        {"type": "string", "enum": []},
+        {"type": "array", "minItems": 3},
+        {"type": "frobnicate"},
+    ):
+        with pytest.raises(ValueError):
+            jsonschema.compile_schema(bad)
+
+
+def test_open_object_is_still_an_object():
+    """{"type": "object"} with no properties means free-form KEYS, not
+    free-form VALUE: a number/string/array must not satisfy it."""
+    m = _machine({"type": "object", "properties": {
+        "args": {"type": "object"}}, "required": ["args"]})
+    for bad in ('{"args": 42}', '{"args": "s"}', '{"args": [1]}'):
+        st = _run(m, bad)
+        assert st is None or not m.terminal(st), bad
+    ok = _run(m, '{"args": {"x": [1, {"y": null}]}}')
+    assert ok is not None and m.terminal(ok)
+
+
+def test_compile_malformed_inputs_raise_value_error():
+    """Client-supplied schemas must fail as ValueError (the service maps
+    it to INVALID_ARGUMENT), never TypeError/AttributeError."""
+    for bad in (
+        {"type": "string", "enum": ["a", 1]},
+        {"type": "object", "properties": {"a": {}}, "required": 5},
+        {"type": "object", "properties": 3},
+        {"const": 5},
+        {"type": "string", "enum": 7},
+    ):
+        with pytest.raises(ValueError):
+            jsonschema.compile_schema(bad)
+
+
+def test_enum_values_needing_escapes_rejected():
+    for v in ('say "hi"', "a\\b", "nl\n"):
+        with pytest.raises(ValueError, match="escape"):
+            jsonschema.compile_schema({"type": "string", "enum": [v]})
+
+
+def test_escape_feasibility_generic(cpu_devices):
+    """Adversarial walk through the GENERIC grammar with tight budgets:
+    \\uXXXX escapes must never strand the output (the distance for X/U
+    states counts the full escape; regression for the budget gate)."""
+    from aios_tpu.engine.jsonmode import JsonConstraint, JsonMaskCache
+    from aios_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    table = jsonmode.token_bytes_table(tok, tok.vocab_size)
+    cache = JsonMaskCache(table, tok.eos_id)
+    for mt in (6, 10, 14, 20):
+        c = JsonConstraint(cache)
+        out = []
+        for _ in range(mt):
+            row = c.mask_row(remaining=mt - len(out))
+            cand = [
+                a for a in np.flatnonzero(row == 0.0) if a != tok.eos_id
+            ]
+            if not cand:
+                break
+            fd = cache.dist_row(c.state)
+            pick = max(cand, key=lambda a: int(fd[a]))
+            out.append(pick)
+            c.advance(pick)
+        assert c.satisfied, (mt, bytes(
+            b for t in out for b in table[t]
+        ))
+        json.loads(bytes(b for t in out for b in table[t]).decode())
+
+
+def test_min_items_one():
+    m = _machine({"type": "object", "properties": {
+        "xs": {"type": "array", "items": {"type": "integer"},
+               "minItems": 1}}, "required": ["xs"]})
+    assert _run(m, '{"xs": [1]}') is not None
+    st = _run(m, '{"xs": []}')
+    assert st is None or not m.terminal(st)
+
+
+def test_const_string():
+    m = _machine({"type": "object", "properties": {
+        "v": {"const": "fixed"}}, "required": ["v"]})
+    ok = _run(m, '{"v": "fixed"}')
+    assert ok is not None and m.terminal(ok)
+    assert _run(m, '{"v": "other"}') is None
+
+
+# ---------------------------------------------------------------------------
+# budget feasibility: constrained walks ALWAYS complete when they can
+# ---------------------------------------------------------------------------
+
+
+def _cache(schema):
+    tok = ByteTokenizer()
+    table = jsonmode.token_bytes_table(tok, tok.vocab_size)
+    return jsonschema.SchemaMaskCache(table, tok.eos_id, schema), tok, table
+
+
+@pytest.mark.parametrize("mode", ["worst", "rand", "best"])
+@pytest.mark.parametrize("max_tokens", [16, 20, 32, 64])
+def test_adversarial_walks_always_complete(mode, max_tokens):
+    """Feasibility-gated masks guarantee completion by induction — even an
+    adversary that always picks the allowed token FARTHEST from terminal
+    must produce a conforming object within the budget."""
+    schema = {
+        "type": "object",
+        "properties": {"status": {"type": "string", "enum": ["ok", "error"]},
+                       "value": {"type": "integer"}},
+        "required": ["status"],
+    }
+    cache, tok, table = _cache(schema)
+    rng = np.random.default_rng(max_tokens)
+    c = JsonConstraint(cache)
+    emitted = []
+    for _ in range(max_tokens):
+        remaining = max_tokens - len(emitted)
+        row = c.mask_row(remaining=remaining)
+        cand = [a for a in np.flatnonzero(row == 0.0) if a != tok.eos_id]
+        if not cand:
+            break
+        fd = cache.dist_row(c.state)
+        if mode == "worst":
+            pick = max(cand, key=lambda a: int(fd[a]))
+        elif mode == "rand":
+            pick = int(rng.choice(cand))
+        else:
+            pick = min(cand, key=lambda a: int(fd[a]))
+        emitted.append(pick)
+        c.advance(pick)
+    assert c.satisfied
+    obj = json.loads(bytes(b for t in emitted for b in table[t]).decode())
+    assert obj["status"] in ("ok", "error")
+
+
+def test_distance_monotone_along_closing():
+    cache, tok, table = _cache(TOOL_SCHEMA)
+    st = cache.start()
+    d = cache._distance(st)
+    seen = 0
+    while not cache._terminal(st):
+        fd = cache.dist_row(st)
+        best = int(np.argmin(fd))
+        st2 = cache.run(st, table[best])
+        assert st2 is not None
+        d2 = cache._distance(st2)
+        assert d2 < d, (st, d, st2, d2)  # every closing byte strictly helps
+        st, d = st2, d2
+        seen += 1
+        assert seen < 64
+
+
+# ---------------------------------------------------------------------------
+# generation + service surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serving():
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = TPUEngine(cfg, params, num_slots=2, max_context=256,
+                    cache_dtype=jnp.float32, seed=7)
+    tok = ByteTokenizer()
+    batcher = ContinuousBatcher(eng, tokenizer=tok)
+    yield eng, tok, batcher
+    batcher.shutdown()
+    eng.close()
+
+
+def test_generations_conform(serving):
+    _, tok, batcher = serving
+    for i in range(8):
+        mt = (16, 24, 48, 96)[i % 4]
+        h = batcher.submit(Request(
+            prompt_ids=tok.encode(f"q{i}"), max_tokens=mt, temperature=1.0,
+            top_p=0.95, stop_ids=(tok.eos_id,), json_schema=TOOL_SCHEMA,
+        ))
+        obj = json.loads(tok.decode(h.tokens()))
+        assert isinstance(obj["done"], bool)
+        assert set(obj) <= {"tool_calls", "done", "count"}
+        for call in obj.get("tool_calls", []):
+            assert call["tool"] in ("fs.read", "fs.write", "net.ping")
+
+
+def test_infeasible_budget_fails_fast(serving):
+    _, tok, batcher = serving
+    with pytest.raises(ValueError, match="minimal completion"):
+        batcher.submit(Request(
+            prompt_ids=tok.encode("x"), max_tokens=4,
+            json_schema=TOOL_SCHEMA,
+        ))
+
+
+def test_schema_over_grpc():
+    import grpc
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    schema = json.dumps({
+        "type": "object",
+        "properties": {"status": {"type": "string", "enum": ["ok", "error"]}},
+        "required": ["status"],
+    })
+    manager = ModelManager(num_slots=2, warm_compile=False)
+    server, _s, port = serve(
+        address="127.0.0.1:0", manager=manager, block=False
+    )
+    try:
+        stub = services.AIRuntimeStub(
+            rpc.insecure_channel(f"127.0.0.1:{port}")
+        )
+        r = stub.LoadModel(runtime_pb2.LoadModelRequest(
+            model_name="tiny", model_path="synthetic://tiny-test",
+            context_length=256,
+        ))
+        assert r.status == "ready"
+        resp = stub.Infer(runtime_pb2.InferRequest(
+            model="tiny", prompt="status?", max_tokens=32, temperature=1.0,
+            json_schema=schema,
+        ))
+        assert json.loads(resp.text)["status"] in ("ok", "error")
+        for bad in ("{not json", '{"type": "string"}'):
+            with pytest.raises(grpc.RpcError) as e:
+                stub.Infer(runtime_pb2.InferRequest(
+                    model="tiny", prompt="x", max_tokens=20,
+                    json_schema=bad,
+                ))
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop(0)
