@@ -66,7 +66,7 @@ class ClaudeClient:
         return bool(self.api_key)
 
     def infer(self, prompt: str, system: str, max_tokens: int,
-              temperature: float) -> InferResult:
+              temperature: float, json_schema: str = "") -> InferResult:
         payload = {
             "model": self.model,
             "max_tokens": max_tokens or 1024,
@@ -112,7 +112,7 @@ class OpenAICompatClient:
         return bool(self.api_key)
 
     def infer(self, prompt: str, system: str, max_tokens: int,
-              temperature: float) -> InferResult:
+              temperature: float, json_schema: str = "") -> InferResult:
         messages = []
         if system:
             messages.append({"role": "system", "content": system})
@@ -179,7 +179,7 @@ class LocalRuntimeClient:
         return self._stub
 
     def infer(self, prompt: str, system: str, max_tokens: int,
-              temperature: float) -> InferResult:
+              temperature: float, json_schema: str = "") -> InferResult:
         import grpc
 
         from ..proto_gen import runtime_pb2
@@ -191,6 +191,9 @@ class LocalRuntimeClient:
                     system_prompt=system,
                     max_tokens=max_tokens or 512,
                     temperature=temperature,
+                    # structured output rides through to the TPU engine's
+                    # grammar-guided decoding; cloud providers ignore it
+                    json_schema=json_schema,
                 ),
                 timeout=120,
             )

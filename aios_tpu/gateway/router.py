@@ -142,8 +142,13 @@ class RequestRouter:
         agent: str = "",
         task_id: str = "",
         use_cache: bool = True,
+        json_schema: str = "",
     ) -> InferResult:
-        cache_key = self.cache.key(prompt, system, max_tokens, temperature)
+        # a schema-constrained response is NOT interchangeable with the
+        # unconstrained response for the same prompt — key the cache on it
+        cache_key = self.cache.key(
+            prompt, system + "\x00" + json_schema, max_tokens, temperature
+        )
         if use_cache:
             hit = self.cache.get(cache_key)
             if hit is not None:
@@ -152,7 +157,10 @@ class RequestRouter:
         errors: List[str] = []
         for name, provider in self._candidates(preferred, allow_fallback, errors):
             try:
-                result = provider.infer(prompt, system, max_tokens, temperature)
+                result = provider.infer(
+                    prompt, system, max_tokens, temperature,
+                    json_schema=json_schema,
+                )
             except ProviderError as exc:
                 self.last_errors[name] = str(exc)
                 errors.append(f"{name}: {exc}")

@@ -67,26 +67,42 @@ TOKEN_BUDGETS = {REACTIVE: 2048, OPERATIONAL: 2048, TACTICAL: 8192,
                  STRATEGIC: 16384}
 
 
-def _call_with_budget(backend, prompt: str, level: str, budget: int) -> str:
-    """Invoke an infer backend, passing the token budget when it takes one.
+def _call_with_budget(
+    backend, prompt: str, level: str, budget: int, json_schema: str = ""
+) -> str:
+    """Invoke an infer backend, passing the token budget when it takes one
+    and the structured-output schema when it is accepted.
 
     Production closures (orchestrator/main.py) have signature
-    (prompt, level, max_tokens); two-arg callables are grandfathered so
-    injected fakes keep working.
+    (prompt, level, max_tokens, json_schema=""); two-arg callables are
+    grandfathered so injected fakes keep working.
     """
     import inspect
 
+    takes_schema = False
     try:
-        params = inspect.signature(backend).parameters.values()
+        sig = inspect.signature(backend)
+        params = sig.parameters.values()
+        # json_schema is always passed BY KEYWORD, so it must not count
+        # toward the positional-budget slot (a backend like
+        # f(prompt, level, json_schema="") takes no budget)
         positional = [
             p for p in params
             if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.name != "json_schema"
         ]
         takes_budget = len(positional) >= 3 or any(
             p.kind is p.VAR_POSITIONAL for p in params
         )
+        takes_schema = "json_schema" in sig.parameters or any(
+            p.kind is p.VAR_KEYWORD for p in params
+        )
     except (TypeError, ValueError):
         takes_budget = True
+    if json_schema and takes_schema:
+        if takes_budget:
+            return backend(prompt, level, budget, json_schema=json_schema)
+        return backend(prompt, level, json_schema=json_schema)
     if takes_budget:
         return backend(prompt, level, budget)
     return backend(prompt, level)
@@ -111,6 +127,65 @@ Set "done": true with empty tool_calls when the task is complete, and put
 your final answer in "thought". If no listed tool fits, you may create one
 with {"tool": "plugin.create", "args": {"name": "...", "code": "def main(input_data): ..."}}.
 """
+
+
+def guided_toolcalls() -> bool:
+    """AIOS_TPU_GUIDED_TOOLCALLS=1: reasoning-round replies are
+    grammar-guided to the tool_calls shape (tool names constrained to the
+    live catalog) via the gateway/runtime json_schema field — the first
+    round parses by construction instead of relying on the JSON-repair
+    round. Opt-in: the reference has no equivalent (it re-prompts,
+    autonomy.rs:290-328), and cloud providers ignore the schema."""
+    import os
+
+    return os.environ.get("AIOS_TPU_GUIDED_TOOLCALLS", "").lower() in (
+        "1", "true", "on",
+    )
+
+
+def _enum_safe(name: str) -> bool:
+    """The engine's schema compiler rejects enum values needing JSON string
+    escapes (jsonschema._check_enum_value); a single unsafe tool name must
+    not poison every guided reasoning call."""
+    return bool(name) and '"' not in name and "\\" not in name and all(
+        ord(c) >= 0x20 for c in name
+    )
+
+
+def toolcalls_schema(catalog: List[str]) -> dict:
+    """The reasoning-reply schema (engine/jsonschema.py subset): thought,
+    tool_calls with catalog-enum tool names + free-form args, done.
+    Unsafe names are dropped from the enum; if none survive, the tool
+    field degrades to a free string (still shape-guided, not name-guided).
+    """
+    safe = [t for t in catalog if _enum_safe(t)]
+    if len(safe) < len(catalog):
+        log.warning(
+            "guided tool_calls: %d catalog names unsafe for the enum",
+            len(catalog) - len(safe),
+        )
+    tool_node = (
+        {"type": "string", "enum": safe} if safe else {"type": "string"}
+    )
+    return {
+        "type": "object",
+        "properties": {
+            "thought": {"type": "string"},
+            "tool_calls": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "properties": {
+                        "tool": tool_node,
+                        "args": {"type": "object"},
+                    },
+                    "required": ["tool"],
+                },
+            },
+            "done": {"type": "boolean"},
+        },
+        "required": ["done"],
+    }
 
 
 @dataclass
@@ -394,7 +469,9 @@ class AutonomyLoop:
                 with self._lock:
                     self._in_flight.discard(task.id)
 
-    def _ai_infer(self, prompt: str, level: str) -> Optional[str]:
+    def _ai_infer(
+        self, prompt: str, level: str, json_schema: str = ""
+    ) -> Optional[str]:
         """gateway (preferred qwen3) -> runtime fallback chain.
 
         Every call carries the per-level reasoning token budget
@@ -408,7 +485,9 @@ class AutonomyLoop:
             if backend is None:
                 continue
             try:
-                return _call_with_budget(backend, prompt, level, budget)
+                return _call_with_budget(
+                    backend, prompt, level, budget, json_schema
+                )
             except Exception as exc:  # noqa: BLE001
                 log.warning("AI backend failed: %s", exc)
                 continue
@@ -462,9 +541,17 @@ class AutonomyLoop:
         made_any_call = False
         final_thought = ""
 
+        guided = guided_toolcalls()
         for round_idx in range(max_rounds):
+            # per round: plugin.create can add tools mid-loop, and the
+            # prompt advertises the fresh catalog — the enum must match
+            schema_json = (
+                json.dumps(toolcalls_schema(self._catalog()))
+                if guided
+                else ""
+            )
             prompt = self._build_prompt(task, all_results, round_idx)
-            reply = self._ai_infer(prompt, level)
+            reply = self._ai_infer(prompt, level, schema_json)
             if reply is None:
                 self._record_failure(task, "no AI backend available")
                 return
@@ -477,7 +564,7 @@ class AutonomyLoop:
                     "Your previous reply was not valid JSON.\n"
                     f"Previous reply:\n{reply[:800]}\n\n" + TOOL_CALL_FORMAT
                 )
-                reply = self._ai_infer(correction, level)
+                reply = self._ai_infer(correction, level, schema_json)
                 if reply is None:
                     self._record_failure(task, "no AI backend available")
                     return

@@ -46,9 +46,12 @@ def build_orchestrator(
 
     # --- gRPC glue ---------------------------------------------------------
 
-    def gateway_infer(prompt: str, level: str = "", max_tokens: int = 0) -> str:
+    def gateway_infer(prompt: str, level: str = "", max_tokens: int = 0,
+                      json_schema: str = "") -> str:
         """max_tokens carries the autonomy loop's per-level reasoning budget
-        (autonomy.TOKEN_BUDGETS; reference autonomy.rs:596-607)."""
+        (autonomy.TOKEN_BUDGETS; reference autonomy.rs:596-607);
+        json_schema the guided tool_calls shape (AIOS_TPU_GUIDED_TOOLCALLS),
+        honored by the local TPU provider."""
         resp = clients.gateway.Infer(
             api_gateway_pb2.ApiInferRequest(
                 prompt=prompt,
@@ -56,18 +59,21 @@ def build_orchestrator(
                 preferred_provider=(autonomy_config or AutonomyConfig()).preferred_provider,
                 allow_fallback=True,
                 requesting_agent="autonomy-loop",
+                json_schema=json_schema,
             ),
             timeout=150,
         )
         return resp.text
 
-    def runtime_infer(prompt: str, level: str = "", max_tokens: int = 0) -> str:
+    def runtime_infer(prompt: str, level: str = "", max_tokens: int = 0,
+                      json_schema: str = "") -> str:
         resp = clients.runtime.Infer(
             runtime_pb2.InferRequest(
                 prompt=prompt,
                 max_tokens=max_tokens,
                 intelligence_level=level or "tactical",
                 requesting_agent="autonomy-loop",
+                json_schema=json_schema,
             ),
             timeout=150,
         )

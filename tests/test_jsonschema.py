@@ -335,3 +335,71 @@ def test_schema_over_grpc():
             assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
     finally:
         server.stop(0)
+
+
+def test_guided_toolcalls_end_to_end(monkeypatch):
+    """AIOS_TPU_GUIDED_TOOLCALLS=1: the autonomy reasoning loop sends the
+    tool_calls schema (tool names = live catalog enum) with every infer,
+    the runtime grammar-constrains the reply, and any tool the model
+    calls is catalog-valid by construction."""
+    monkeypatch.setenv("AIOS_TPU_GUIDED_TOOLCALLS", "1")
+    from aios_tpu import rpc, services
+    from aios_tpu.orchestrator.agent_router import AgentRouter
+    from aios_tpu.orchestrator.autonomy import AutonomyLoop, guided_toolcalls
+    from aios_tpu.orchestrator.goal_engine import GoalEngine, Task
+    from aios_tpu.orchestrator.task_planner import TaskPlanner
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    assert guided_toolcalls()
+    manager = ModelManager(num_slots=2, warm_compile=False)
+    server, _s, port = serve(
+        address="127.0.0.1:0", manager=manager, block=False
+    )
+    try:
+        stub = services.AIRuntimeStub(
+            rpc.insecure_channel(f"127.0.0.1:{port}")
+        )
+        r = stub.LoadModel(runtime_pb2.LoadModelRequest(
+            model_name="tiny", model_path="synthetic://tiny-test",
+            context_length=512,
+        ))
+        assert r.status == "ready"
+        catalog = ["fs.read", "net.ping", "monitor.cpu"]
+        calls_made = []
+        schemas_seen = []
+
+        def execute_tool(tool, agent_id, args):
+            calls_made.append(tool)
+            return {"success": True, "output": "done", "error": ""}
+
+        def runtime_infer(prompt, level="", max_tokens=0, json_schema=""):
+            schemas_seen.append(json_schema)
+            assert json_schema, "schema must ride on every reasoning call"
+            resp = stub.Infer(runtime_pb2.InferRequest(
+                prompt=prompt, max_tokens=min(max_tokens or 256, 200),
+                intelligence_level=level or "tactical",
+                json_schema=json_schema,
+            ))
+            return resp.text
+
+        engine = GoalEngine()
+        loop = AutonomyLoop(
+            engine, TaskPlanner(), AgentRouter(), execute_tool,
+            runtime_infer=runtime_infer, tool_catalog=lambda: catalog,
+        )
+        g = engine.submit_goal("investigate anomaly", "desc")
+        task = Task(id="t1", goal_id=g.id, description="investigate",
+                    intelligence_level="tactical")
+        engine.add_tasks(g.id, [task])
+        loop.run_reasoning_loop(task)
+        assert schemas_seen
+        sch = json.loads(schemas_seen[0])
+        enum = sch["properties"]["tool_calls"]["items"]["properties"][
+            "tool"
+        ]["enum"]
+        assert enum == catalog
+        assert all(c in catalog for c in calls_made)
+    finally:
+        server.stop(0)
