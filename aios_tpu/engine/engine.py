@@ -168,8 +168,15 @@ class TPUEngine:
         self.prefix_index: Optional[paged.PrefixIndex] = None
         self._prefix_chunk: Optional[int] = None
         if self.paged:
-            if shardings is not None:
-                raise ValueError("paged KV cache is single-chip for now")
+            if shardings is not None and (
+                shardings.dp > 1 or shardings.sp > 1
+            ):
+                # the page pool is shared across ALL slots, so slots cannot
+                # shard over dp; TP is fine (pages shard kv heads only)
+                raise ValueError(
+                    "paged KV cache composes with TP only (dp=sp=1): the "
+                    "shared page pool cannot split slots across dp shards"
+                )
             if page_size < 1 or page_size & (page_size - 1):
                 # chunked admission relies on power-of-two chunk/page sizes
                 # never straddling (model.prefill_chunk_paged)
@@ -239,6 +246,12 @@ class TPUEngine:
                 )
                 k_s = jnp.ones(s_shape, jnp.float32)
                 v_s = jnp.ones(s_shape, jnp.float32)
+                if shardings is not None:
+                    # pool scales [L, N, P, KH]: same spec as dense scales
+                    # ([L, S, C, KH]) — axis 1 rides the size-1 dp axis,
+                    # kv heads shard over tp
+                    k_s = shardings.put_cache_scales(k_s)
+                    v_s = shardings.put_cache_scales(v_s)
             else:
                 k_s, v_s = model.init_kv_scales(
                     cfg, num_slots, self.max_context

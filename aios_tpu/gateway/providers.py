@@ -160,6 +160,7 @@ class LocalRuntimeClient:
     """The TPU runtime as a gateway provider (final fallback, always on)."""
 
     name = "local"
+    supports_json_schema = True  # grammar-guided decoding in the engine
 
     def __init__(self, address: Optional[str] = None):
         from ..services import service_address
@@ -209,7 +210,7 @@ class LocalRuntimeClient:
         )
 
     def stream_infer(self, prompt: str, system: str, max_tokens: int,
-                     temperature: float):
+                     temperature: float, json_schema: str = ""):
         """Yield text deltas live from the runtime's StreamInfer.
 
         This is the true-streaming path the reference never had: its
@@ -229,6 +230,7 @@ class LocalRuntimeClient:
                     system_prompt=system,
                     max_tokens=max_tokens or 512,
                     temperature=temperature,
+                    json_schema=json_schema,
                 ),
                 timeout=300,
             )

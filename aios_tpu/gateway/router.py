@@ -167,8 +167,14 @@ class RequestRouter:
                 if not allow_fallback:
                     break
                 continue
+            # a provider that IGNORES the schema returns unconstrained
+            # text; caching it under the schema-keyed entry would serve
+            # non-conforming responses to later schema requests
+            honors = not json_schema or getattr(
+                provider, "supports_json_schema", False
+            )
             self._record_and_cache(
-                name, result, agent, task_id, use_cache, cache_key
+                name, result, agent, task_id, use_cache and honors, cache_key
             )
             return result
         raise ProviderError("all providers failed: " + "; ".join(errors))
@@ -184,6 +190,7 @@ class RequestRouter:
         agent: str = "",
         task_id: str = "",
         use_cache: bool = True,
+        json_schema: str = "",
     ):
         """Route with live streaming: yields (text_delta, provider_name).
 
@@ -195,7 +202,10 @@ class RequestRouter:
         provider happens only before the first delta is emitted; after
         that, a mid-stream failure surfaces to the caller.
         """
-        cache_key = self.cache.key(prompt, system, max_tokens, temperature)
+        # same composite key as route() so the two paths share hits
+        cache_key = self.cache.key(
+            prompt, system + "\x00" + json_schema, max_tokens, temperature
+        )
         if use_cache:
             hit = self.cache.get(cache_key)
             if hit is not None:
@@ -218,7 +228,8 @@ class RequestRouter:
                 try:
                     try:
                         for delta in provider.stream_infer(
-                            prompt, system, max_tokens, temperature
+                            prompt, system, max_tokens, temperature,
+                            json_schema=json_schema,
                         ):
                             pieces.append(delta)
                             yield delta, name
@@ -238,6 +249,9 @@ class RequestRouter:
                         continue
                 finally:
                     if pieces:
+                        honors = not json_schema or getattr(
+                            provider, "supports_json_schema", False
+                        )
                         self._record_and_cache(
                             name,
                             InferResult(
@@ -249,12 +263,15 @@ class RequestRouter:
                             ),
                             agent,
                             task_id,
-                            use_cache and completed,
+                            use_cache and completed and honors,
                             cache_key,
                         )
                 return
             try:
-                result = provider.infer(prompt, system, max_tokens, temperature)
+                result = provider.infer(
+                    prompt, system, max_tokens, temperature,
+                    json_schema=json_schema,
+                )
             except ProviderError as exc:
                 self.last_errors[name] = str(exc)
                 errors.append(f"{name}: {exc}")
@@ -263,8 +280,11 @@ class RequestRouter:
                 continue
             # record BEFORE yielding: the provider call is already paid for
             # even if the client disconnects during the rechunk relay
+            honors = not json_schema or getattr(
+                provider, "supports_json_schema", False
+            )
             self._record_and_cache(
-                name, result, agent, task_id, use_cache, cache_key
+                name, result, agent, task_id, use_cache and honors, cache_key
             )
             if not result.text:
                 yield "", name  # attribute the terminal chunk (see above)

@@ -66,6 +66,7 @@ class GatewayService(ApiGatewayServicer):
                 temperature=request.temperature or 0.7,
                 preferred=request.preferred_provider,
                 allow_fallback=request.allow_fallback,
+                json_schema=getattr(request, "json_schema", ""),
                 agent=request.requesting_agent,
                 task_id=request.task_id,
             ):

@@ -504,7 +504,7 @@ class AutonomyLoop:
         return STATIC_TOOL_CATALOG  # autonomy.rs:1039-1055
 
     def _build_prompt(self, task: Task, round_results: List[dict],
-                      round_idx: int) -> str:
+                      round_idx: int, catalog: Optional[List[str]] = None) -> str:
         parts = [
             "You are the aiOS autonomy loop executing a system task.",
             f"Task: {task.description}",
@@ -521,7 +521,9 @@ class AutonomyLoop:
         if history:
             rendered = "\n".join(f"{m.role}: {m.content[:300]}" for m in history)
             parts.append(f"Conversation so far:\n{rendered}")
-        parts.append("Available tools: " + ", ".join(self._catalog()))
+        parts.append(
+            "Available tools: " + ", ".join(catalog or self._catalog())
+        )
         if round_results:
             rendered = json.dumps(round_results)[:TOOL_RESULT_TRUNCATE * 3]
             parts.append(
@@ -543,14 +545,14 @@ class AutonomyLoop:
 
         guided = guided_toolcalls()
         for round_idx in range(max_rounds):
-            # per round: plugin.create can add tools mid-loop, and the
-            # prompt advertises the fresh catalog — the enum must match
+            # ONE catalog fetch per round, shared by the schema enum and
+            # the prompt's tool list (plugin.create can add tools
+            # mid-loop; the enum must match what the prompt advertises)
+            catalog = self._catalog()
             schema_json = (
-                json.dumps(toolcalls_schema(self._catalog()))
-                if guided
-                else ""
+                json.dumps(toolcalls_schema(catalog)) if guided else ""
             )
-            prompt = self._build_prompt(task, all_results, round_idx)
+            prompt = self._build_prompt(task, all_results, round_idx, catalog)
             reply = self._ai_infer(prompt, level, schema_json)
             if reply is None:
                 self._record_failure(task, "no AI backend available")

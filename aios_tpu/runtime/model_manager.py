@@ -158,12 +158,16 @@ class ModelManager:
                 rows = int(paged_env)
             except ValueError:
                 rows = 0
-            if rows > 0 and sharding_plan is None:
+            tp_only = sharding_plan is None or (
+                sharding_plan.dp == 1 and sharding_plan.sp == 1
+            )
+            if rows > 0 and tp_only:
                 self.paged_pool_rows = rows
             else:
                 log.warning(
                     "AIOS_TPU_PAGED_KV=%r ignored (need a positive row "
-                    "count and no sharding plan)", paged_env,
+                    "count; composes with TP-only plans, dp=sp=1)",
+                    paged_env,
                 )
         # AIOS_TPU_SPECULATIVE=1 turns on n-gram speculative decode
         # dispatches (engine/spec.py): greedy agent requests — tool-call

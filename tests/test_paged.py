@@ -633,3 +633,61 @@ def test_batcher_fails_only_oversized_prompt(params):
     assert big_out == []  # failed cleanly, iterator ended
     assert len(small_out) == 8  # unaffected
     eng.close()
+
+
+# ---------------------------------------------------------------------------
+# paged pool under tensor parallelism (dp=sp=1)
+# ---------------------------------------------------------------------------
+
+
+def test_paged_pool_composes_with_tp(params, cpu_devices):
+    """Pages shard kv heads over tp; outputs bit-match single-chip paged,
+    prefix caching still hits, and the int8 pool rides along."""
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    plan = ShardingPlan(build_mesh(2, dp=1, tp=2))
+    kw = dict(num_slots=4, max_context=256, cache_dtype=jnp.float32,
+              paged_pool_rows=4 * 256, page_size=32)
+    ref = TPUEngine(TINY_TEST, params, **kw)
+    tp = TPUEngine(TINY_TEST, params, shardings=plan, **kw)
+    try:
+        assert str(tp.state["k"].sharding.spec).find("'tp'") != -1
+        prompt = [1, 2, 3, 4, 5] * 3
+        assert tp.generate(prompt, max_new_tokens=24, temperature=0.0) == \
+            ref.generate(prompt, max_new_tokens=24, temperature=0.0)
+        pre = list(range(1, 70))
+        tp.prefill(0, pre + [7], temperature=0.0)
+        tp.release(0)
+        before = tp.prefix_rows_reused
+        tp.prefill(1, pre + [9], temperature=0.0)
+        assert tp.prefix_rows_reused > before  # prefix hit under TP
+    finally:
+        tp.close()
+        ref.close()
+
+
+def test_paged_pool_int8_under_tp(params, cpu_devices):
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    plan = ShardingPlan(build_mesh(2, dp=1, tp=2))
+    kw = dict(num_slots=2, max_context=128, cache_dtype=jnp.int8,
+              paged_pool_rows=256, page_size=32)
+    ref = TPUEngine(TINY_TEST, params, **kw)
+    tp = TPUEngine(TINY_TEST, params, shardings=plan, **kw)
+    try:
+        assert tp.generate([1, 2, 3, 4], max_new_tokens=12,
+                           temperature=0.0) == \
+            ref.generate([1, 2, 3, 4], max_new_tokens=12, temperature=0.0)
+    finally:
+        tp.close()
+        ref.close()
+
+
+def test_paged_pool_refuses_dp_sharding(params, cpu_devices):
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    plan = ShardingPlan(build_mesh(4, dp=2, tp=2))
+    with pytest.raises(ValueError, match="TP only"):
+        TPUEngine(TINY_TEST, params, num_slots=4, max_context=256,
+                  cache_dtype=jnp.float32, paged_pool_rows=256,
+                  page_size=32, shardings=plan)
