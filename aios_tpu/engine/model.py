@@ -885,10 +885,11 @@ def decode_step_paged(
     would silently cross-talk through the sacrificial page.
 
     ``cache_scales`` — (k_scales, v_scales) [L, N, P, KH] f32 marks an
-    int8 POOL: rows quantize on write and the gathered per-slot view
-    dequantizes on read (the paged kernel reads bf16 pools only, so int8
-    stays on the gather path). Returns (logits [B, V] fp32, k_pool',
-    v_pool'[, (k_scales', v_scales')]).
+    int8 POOL: rows quantize on write; attention either streams the int8
+    pages through the paged kernel with scales folded into the dots
+    (AIOS_TPU_INT8_RAGGED=1, ops.paged_decode_attention_int8) or
+    dequantizes a gathered per-slot view on the XLA path. Returns
+    (logits [B, V] fp32, k_pool', v_pool'[, (k_scales', v_scales')]).
     """
     B = tokens.shape[0]
     MB = tables.shape[1]
@@ -896,6 +897,11 @@ def decode_step_paged(
     C = MB * P
     quant_pool = cache_scales is not None
     use_kernel = _use_kernels(kernels) and not quant_pool
+    # int8 pool through the paged kernel (same env gate as the dense int8
+    # ragged kernel): pages stream as int8 with scales folded into the dots
+    use_int8_kernel = (
+        _use_kernels(kernels) and quant_pool and _int8_ragged_enabled()
+    )
     if active is None:
         write_pages_of = lengths
         read_lengths = lengths
@@ -913,7 +919,8 @@ def decode_step_paged(
     x = params["embed"][tokens][:, None, :]  # [B, 1, E]
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
 
-    if quant_pool:  # layer-invariant mask, built once like decode_step's
+    if quant_pool and not use_int8_kernel:
+        # layer-invariant mask, built once like decode_step's
         cols = jnp.arange(C)[None, :]
         mask = cols <= read_lengths[:, None]
         if cfg.sliding_window is not None:
@@ -932,12 +939,18 @@ def decode_step_paged(
         if quant_pool:
             k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new[:, 0])
             v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new[:, 0])
-            attn = gqa_attention(
-                q,
-                gather_dequant(k_l, k_s, tables, q.dtype),
-                gather_dequant(v_l, v_s, tables, q.dtype),
-                mask,
-            )
+            if use_int8_kernel:
+                attn = ops.paged_decode_attention_int8(
+                    q[:, 0], k_l, v_l, k_s, v_s, tables, read_lengths,
+                    window=cfg.sliding_window,
+                )[:, None]
+            else:
+                attn = gqa_attention(
+                    q,
+                    gather_dequant(k_l, k_s, tables, q.dtype),
+                    gather_dequant(v_l, v_s, tables, q.dtype),
+                    mask,
+                )
         else:
             k_l = k_l.at[pages, offs].set(k_new[:, 0].astype(k_l.dtype))
             v_l = v_l.at[pages, offs].set(v_new[:, 0].astype(v_l.dtype))

@@ -131,6 +131,14 @@ class RequestRouter:
         if use_cache:
             self.cache.put(cache_key, result)
 
+    @staticmethod
+    def _honors_schema(provider, json_schema: str) -> bool:
+        """Cache eligibility: a schema-keyed entry may only hold a response
+        from a provider that actually HONORS the schema."""
+        return not json_schema or getattr(
+            provider, "supports_json_schema", False
+        )
+
     def route(
         self,
         prompt: str,
@@ -170,9 +178,7 @@ class RequestRouter:
             # a provider that IGNORES the schema returns unconstrained
             # text; caching it under the schema-keyed entry would serve
             # non-conforming responses to later schema requests
-            honors = not json_schema or getattr(
-                provider, "supports_json_schema", False
-            )
+            honors = self._honors_schema(provider, json_schema)
             self._record_and_cache(
                 name, result, agent, task_id, use_cache and honors, cache_key
             )
@@ -249,8 +255,8 @@ class RequestRouter:
                         continue
                 finally:
                     if pieces:
-                        honors = not json_schema or getattr(
-                            provider, "supports_json_schema", False
+                        honors = self._honors_schema(
+                            provider, json_schema
                         )
                         self._record_and_cache(
                             name,
@@ -280,9 +286,7 @@ class RequestRouter:
                 continue
             # record BEFORE yielding: the provider call is already paid for
             # even if the client disconnects during the rechunk relay
-            honors = not json_schema or getattr(
-                provider, "supports_json_schema", False
-            )
+            honors = self._honors_schema(provider, json_schema)
             self._record_and_cache(
                 name, result, agent, task_id, use_cache and honors, cache_key
             )

@@ -38,6 +38,8 @@ from .flash_attention import flash_attention, flash_attention_reference
 from .paged_attention import (
     gather_pages,
     paged_decode_attention,
+    paged_decode_attention_int8,
+    paged_decode_attention_int8_reference,
     paged_decode_attention_reference,
 )
 from .quantized_matmul import (
@@ -59,6 +61,8 @@ __all__ = [
     "decode_attention_int8_reference",
     "decode_attention_reference",
     "paged_decode_attention",
+    "paged_decode_attention_int8",
+    "paged_decode_attention_int8_reference",
     "paged_decode_attention_reference",
     "gather_pages",
     "multiquery_decode_attention",

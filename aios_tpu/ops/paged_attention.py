@@ -34,16 +34,20 @@ def _paged_decode_kernel(
     len_ref,  # SMEM [B] int32
     tbl_ref,  # SMEM [B, MB] int32 — logical block -> physical page
     q_ref,  # VMEM [1, H, D]
-    k_pool,  # ANY  [N, P, KH*D]
+    k_pool,  # ANY  [N, P, KH*D]  (bf16, or int8 when quantized)
     v_pool,  # ANY  [N, P, KH*D]
-    o_ref,  # VMEM [1, H, D]
-    *,
+    *rest,  # quantized: ks_pool [N, P, KH] f32, vs_pool, o_ref; else o_ref
     num_kv_heads: int,
     head_dim: int,
     page_size: int,
     window: Optional[int],
     sm_scale: float,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_pool, vs_pool, o_ref = rest
+    else:
+        (o_ref,) = rest
     b = pl.program_id(0)
     KH, D, P = num_kv_heads, head_dim, page_size
     H = q_ref.shape[1]
@@ -57,9 +61,12 @@ def _paged_decode_kernel(
     else:
         start_blk = jnp.int32(0)
 
-    q = q_ref[0] * sm_scale  # [H, D]
+    if quantized:
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # [H, D]
+    else:
+        q = q_ref[0] * sm_scale
 
-    def body(k_buf, v_buf, sems):
+    def body(k_buf, v_buf, sems, ks_buf=None, vs_buf=None):
         def dma(pool, scr, slot, blk, sem_idx):
             # THE paged indirection: logical block -> physical page
             return pltpu.make_async_copy(
@@ -68,8 +75,21 @@ def _paged_decode_kernel(
                 sems.at[slot, sem_idx],
             )
 
-        dma(k_pool, k_buf, 0, start_blk, 0).start()
-        dma(v_pool, v_buf, 0, start_blk, 1).start()
+        def start_all(slot, blk):
+            dma(k_pool, k_buf, slot, blk, 0).start()
+            dma(v_pool, v_buf, slot, blk, 1).start()
+            if quantized:
+                dma(ks_pool, ks_buf, slot, blk, 2).start()
+                dma(vs_pool, vs_buf, slot, blk, 3).start()
+
+        def wait_all(slot, blk):
+            dma(k_pool, k_buf, slot, blk, 0).wait()
+            dma(v_pool, v_buf, slot, blk, 1).wait()
+            if quantized:
+                dma(ks_pool, ks_buf, slot, blk, 2).wait()
+                dma(vs_pool, vs_buf, slot, blk, 3).wait()
+
+        start_all(0, start_blk)
 
         def loop(i, carry):
             m, l, acc = carry  # [H, 1], [H, 1], [H, D] f32
@@ -77,14 +97,13 @@ def _paged_decode_kernel(
 
             @pl.when(i + 1 < n_blk)
             def _prefetch():
-                nxt = 1 - slot
-                dma(k_pool, k_buf, nxt, i + 1, 0).start()
-                dma(v_pool, v_buf, nxt, i + 1, 1).start()
+                start_all(1 - slot, i + 1)
 
-            dma(k_pool, k_buf, slot, i, 0).wait()
-            dma(v_pool, v_buf, slot, i, 1).wait()
+            wait_all(slot, i)
             kb = k_buf[slot]  # [P, KH*D]
             vb = v_buf[slot]
+            ksb = ks_buf[slot] if quantized else None  # [P, KH] f32
+            vsb = vs_buf[slot] if quantized else None
 
             cols = i * P + jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
             valid = cols <= length
@@ -95,14 +114,17 @@ def _paged_decode_kernel(
             for h in range(KH):
                 qh = q[h * G : (h + 1) * G, :]  # [G, D]
                 kh = kb[:, h * D : (h + 1) * D]  # [P, D]
-                parts.append(
-                    jax.lax.dot_general(
-                        qh,
-                        kh,
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
+                if quantized:
+                    kh = kh.astype(jnp.float32)
+                sh = jax.lax.dot_general(
+                    qh,
+                    kh,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
                 )
+                if quantized:
+                    sh = sh * ksb[:, h][None, :]
+                parts.append(sh)
             s = jnp.concatenate(parts, axis=0)  # [H, P]
             s = jnp.where(valid, s, NEG_INF)
 
@@ -114,10 +136,14 @@ def _paged_decode_kernel(
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
 
             outs = []
-            pv = p.astype(vb.dtype)
+            pv = p if quantized else p.astype(vb.dtype)
             for h in range(KH):
                 ph = pv[h * G : (h + 1) * G, :]  # [G, P]
+                if quantized:
+                    ph = ph * vsb[:, h][None, :]
                 vh = vb[:, h * D : (h + 1) * D]  # [P, D]
+                if quantized:
+                    vh = vh.astype(jnp.float32)
                 outs.append(
                     jax.lax.dot_general(
                         ph,
@@ -138,12 +164,64 @@ def _paged_decode_kernel(
         safe_l = jnp.where(l <= 0.0, 1.0, l)
         o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
 
-    pl.run_scoped(
-        body,
-        k_buf=pltpu.VMEM((2, P, KH * D), k_pool.dtype),
-        v_buf=pltpu.VMEM((2, P, KH * D), v_pool.dtype),
-        sems=pltpu.SemaphoreType.DMA((2, 2)),
+    if quantized:
+        pl.run_scoped(
+            body,
+            k_buf=pltpu.VMEM((2, P, KH * D), jnp.int8),
+            v_buf=pltpu.VMEM((2, P, KH * D), jnp.int8),
+            sems=pltpu.SemaphoreType.DMA((2, 4)),
+            ks_buf=pltpu.VMEM((2, P, KH), jnp.float32),
+            vs_buf=pltpu.VMEM((2, P, KH), jnp.float32),
+        )
+    else:
+        pl.run_scoped(
+            body,
+            k_buf=pltpu.VMEM((2, P, KH * D), k_pool.dtype),
+            v_buf=pltpu.VMEM((2, P, KH * D), v_pool.dtype),
+            sems=pltpu.SemaphoreType.DMA((2, 2)),
+        )
+
+
+def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
+                interpret):
+    """Shared pallas_call plumbing for both pool dtypes."""
+    B, H, D = q.shape
+    N, P, KH = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    quantized = scales is not None
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        num_kv_heads=KH,
+        head_dim=D,
+        page_size=P,
+        window=window,
+        sm_scale=1.0 / float(np.sqrt(D)),
+        quantized=quantized,
     )
+    pool_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * (
+        2 + (2 if quantized else 0)
+    )
+    args = [
+        lengths.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        q,
+        k_pool.reshape(N, P, KH * D),
+        v_pool.reshape(N, P, KH * D),
+    ]
+    if quantized:
+        args.extend(scales)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # page tables
+            pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+            *pool_specs,  # pools (+ scales) stay in HBM
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+        interpret=interpret,
+    )(*args)
 
 
 @functools.partial(jax.jit, static_argnames=("window", "interpret"))
@@ -158,36 +236,51 @@ def paged_decode_attention(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Paged ragged decode attention; returns [B, H, D]."""
-    B, H, D = q.shape
-    N, P, KH = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
-
-    kernel = functools.partial(
-        _paged_decode_kernel,
-        num_kv_heads=KH,
-        head_dim=D,
-        page_size=P,
-        window=window,
-        sm_scale=1.0 / float(np.sqrt(D)),
+    return _paged_call(
+        q, k_pool, v_pool, tables, lengths, None,
+        window=window, interpret=interpret,
     )
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # page tables
-            pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),  # v pool stays in HBM
-        ],
-        out_specs=pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
-        interpret=interpret,
-    )(
-        lengths.astype(jnp.int32),
-        tables.astype(jnp.int32),
-        q,
-        k_pool.reshape(N, P, KH * D),
-        v_pool.reshape(N, P, KH * D),
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def paged_decode_attention_int8(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pool: jnp.ndarray,  # [N, P, KH, D] int8
+    v_pool: jnp.ndarray,  # [N, P, KH, D] int8
+    k_scales: jnp.ndarray,  # [N, P, KH] f32 (layer slice of the pool scales)
+    v_scales: jnp.ndarray,  # [N, P, KH] f32
+    tables: jnp.ndarray,  # [B, MB] int32
+    lengths: jnp.ndarray,  # [B] int32
+    *,
+    window: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Paged ragged decode attention over an INT8 page pool: pages stream
+    as int8 (half the HBM bytes) with per-(page-row, kv-head) scales
+    folded into the score/value dots — same contract as
+    decode_attention_int8 with the page-table indirection."""
+    return _paged_call(
+        q, k_pool, v_pool, tables, lengths, (k_scales, v_scales),
+        window=window, interpret=interpret,
+    )
+
+
+def paged_decode_attention_int8_reference(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,  # [N, P, KH, D] int8
+    v_pool: jnp.ndarray,
+    k_scales: jnp.ndarray,  # [N, P, KH] f32
+    v_scales: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Dequantize-then-attend ground truth for the int8 paged kernel."""
+    kf = k_pool.astype(jnp.float32) * k_scales[..., None]
+    vf = v_pool.astype(jnp.float32) * v_scales[..., None]
+    return paged_decode_attention_reference(
+        q, kf, vf, tables, lengths, window=window
     )
 
 

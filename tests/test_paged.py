@@ -691,3 +691,81 @@ def test_paged_pool_refuses_dp_sharding(params, cpu_devices):
         TPUEngine(TINY_TEST, params, num_slots=4, max_context=256,
                   cache_dtype=jnp.float32, paged_pool_rows=256,
                   page_size=32, shardings=plan)
+
+
+# ---------------------------------------------------------------------------
+# int8 page pool through the paged kernel (interpret mode)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_paged_int8_kernel_parity(window):
+    from aios_tpu.ops import (
+        paged_decode_attention_int8,
+        paged_decode_attention_int8_reference,
+    )
+
+    rng = np.random.default_rng(9)
+    B, H, KH, D, N, P, MB = 3, 8, 2, 16, 16, 16, 4
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.integers(-127, 128, (N, P, KH, D)), jnp.int8)
+    v = jnp.asarray(rng.integers(-127, 128, (N, P, KH, D)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, (N, P, KH)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, (N, P, KH)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, N))[: B * MB].reshape(B, MB), jnp.int32
+    )
+    lens = jnp.asarray([0, 29, 63], jnp.int32)
+    got = paged_decode_attention_int8(
+        q, k, v, ks, vs, tables, lens, window=window, interpret=True
+    )
+    ref = paged_decode_attention_int8_reference(
+        q, k, v, ks, vs, tables, lens, window=window
+    )
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_step_int8_kernel_wiring(monkeypatch):
+    """AIOS_TPU_INT8_RAGGED=1 routes the int8 POOL decode through the
+    paged kernel (reference body stands in on CPU); outputs match the
+    gather-dequant XLA path."""
+    import aios_tpu.ops as ops_mod
+
+    cfg = TINY_TEST
+    params = model.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    B, N, P, MB = 2, 9, 16, 4
+    toks = jnp.asarray([1, 2], jnp.int32)
+    lens = jnp.asarray([5, 11], jnp.int32)
+    k = jnp.zeros((cfg.num_layers, N, P, cfg.num_kv_heads, cfg.head_dim),
+                  jnp.int8)
+    v = jnp.zeros_like(k)
+    scales = (
+        jnp.ones((cfg.num_layers, N, P, cfg.num_kv_heads), jnp.float32),
+        jnp.ones((cfg.num_layers, N, P, cfg.num_kv_heads), jnp.float32),
+    )
+    tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+
+    ref = model.decode_step_paged(
+        params, cfg, toks, lens, k, v, tables, kernels=False,
+        cache_scales=scales,
+    )[0]
+
+    called = {}
+
+    def fake_kernel(q, k_l, v_l, k_s, v_s, tbl, lengths, window=None):
+        called["hit"] = True
+        return ops_mod.paged_decode_attention_int8_reference(
+            q, k_l, v_l, k_s, v_s, tbl, lengths, window=window
+        )
+
+    monkeypatch.setenv("AIOS_TPU_INT8_RAGGED", "1")
+    monkeypatch.setattr(
+        ops_mod, "paged_decode_attention_int8", fake_kernel
+    )
+    got = model.decode_step_paged(
+        params, cfg, toks, lens, k, v, tables, kernels=True,
+        cache_scales=scales,
+    )[0]
+    assert called.get("hit")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
