@@ -461,10 +461,13 @@ def _use_ragged_kernel(
     measures +11% whole-step throughput on v5e).
 
     ``quant_kernel_ok`` — whether the CALLER has an int8-capable kernel
-    for this path: decode_step passes _int8_ragged_enabled() (its kernel
-    ladder includes ops.decode_attention_int8, env-gated until measured);
-    verify/multiquery and the paged kernel are bf16-only, so their int8-KV
-    paths stay on XLA."""
+    for this path: decode_step and verify_step pass
+    _int8_ragged_enabled() (their ladders include the int8 kernel
+    variants, env-gated until measured on chip); callers without one pass
+    False and their int8-KV paths stay on XLA. decode_step_paged does NOT
+    use this crossover at all — like its bf16 path, the paged kernel is
+    always preferable to the gather fallback, so it gates only on
+    _use_kernels + the env flag."""
     kv_row = cfg.num_kv_heads * cfg.head_dim
     return (
         _use_kernels(kernels)
@@ -1127,12 +1130,20 @@ def verify_step(
     qpos = jnp.where(active[:, None], positions, 0)  # [B, T]
     # Ragged multi-query kernel: DMAs only the blocks holding valid rows,
     # same crossover rule as decode_step's single-query kernel
-    # (_use_ragged_kernel); bf16 cache only. Saturated slots run through
-    # whichever path the batch takes with clamped/colliding rows — their
-    # outputs are unconsumed by the saturation contract above; the kernel
-    # clamps its DMA bound at the cache end so the VALID slots stay exact.
-    use_kernel = _use_ragged_kernel(kernels, C, cfg, quant_cache)
-    if use_kernel:
+    # (_use_ragged_kernel). bf16 caches take the plain kernel; int8-KV
+    # routes through the int8 variant (scales folded into the dots, same
+    # AIOS_TPU_INT8_RAGGED gate as decode — drafts score at half the
+    # cache bandwidth). Saturated slots run through whichever path the
+    # batch takes with clamped/colliding rows — their outputs are
+    # unconsumed by the saturation contract above; the kernel clamps its
+    # DMA bound at the cache end so the VALID slots stay exact.
+    routed = _use_ragged_kernel(
+        kernels, C, cfg, quant_cache,
+        quant_kernel_ok=_int8_ragged_enabled(),
+    )
+    use_kernel = routed and not quant_cache
+    use_int8_kernel = routed and quant_cache
+    if use_kernel or use_int8_kernel:
         mask = None
         strides = active.astype(jnp.int32)
         read_base = jnp.where(active, lengths, 0)
@@ -1160,12 +1171,18 @@ def verify_step(
             v_l = v_l.at[batch_idx, write_rows].set(vq)
             k_s = k_s.at[batch_idx, write_rows].set(ks_new)
             v_s = v_s.at[batch_idx, write_rows].set(vs_new)
-            attn = gqa_attention(
-                q,
-                dequantize_kv(k_l, k_s, q.dtype),
-                dequantize_kv(v_l, v_s, q.dtype),
-                mask,
-            )
+            if use_int8_kernel:
+                attn = ops.multiquery_decode_attention_int8(
+                    q, k_l, v_l, k_s, v_s, read_base, strides,
+                    window=cfg.sliding_window,
+                )
+            else:
+                attn = gqa_attention(
+                    q,
+                    dequantize_kv(k_l, k_s, q.dtype),
+                    dequantize_kv(v_l, v_s, q.dtype),
+                    mask,
+                )
         else:
             k_l = k_l.at[batch_idx, write_rows].set(k_new.astype(k_l.dtype))
             v_l = v_l.at[batch_idx, write_rows].set(v_new.astype(v_l.dtype))

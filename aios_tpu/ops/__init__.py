@@ -50,6 +50,8 @@ from .quantized_matmul import (
 )
 from .verify_attention import (
     multiquery_decode_attention,
+    multiquery_decode_attention_int8,
+    multiquery_decode_attention_int8_reference,
     multiquery_decode_attention_reference,
 )
 
@@ -66,6 +68,8 @@ __all__ = [
     "paged_decode_attention_reference",
     "gather_pages",
     "multiquery_decode_attention",
+    "multiquery_decode_attention_int8",
+    "multiquery_decode_attention_int8_reference",
     "multiquery_decode_attention_reference",
     "quantize_int8",
     "dequantize",

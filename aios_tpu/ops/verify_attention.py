@@ -33,16 +33,20 @@ def _mq_kernel(
     len_ref,  # SMEM [B] int32 — base: row `len` holds query 0's row
     stride_ref,  # SMEM [B] int32 — 1 active (staircase), 0 inactive
     q_ref,  # VMEM [1, T, H, D]
-    k_hbm,  # ANY  [B, C, KH*D]
+    k_hbm,  # ANY  [B, C, KH*D]  (bf16, or int8 when quantized)
     v_hbm,  # ANY  [B, C, KH*D]
-    o_ref,  # VMEM [1, T, H, D]
-    *,
+    *rest,  # quantized: ks_hbm [B, C, KH] f32, vs_hbm, o_ref; else o_ref
     num_kv_heads: int,
     head_dim: int,
     block_kv: int,
     window: Optional[int],
     sm_scale: float,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_hbm, vs_hbm, o_ref = rest
+    else:
+        (o_ref,) = rest
     b = pl.program_id(0)
     KH, D, bk = num_kv_heads, head_dim, block_kv
     T, H = q_ref.shape[1], q_ref.shape[2]
@@ -66,7 +70,7 @@ def _mq_kernel(
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [T, H, D]
     qpos = base + jnp.arange(T) * stride  # [T] each query's own row
 
-    def body(k_buf, v_buf, sems):
+    def body(k_buf, v_buf, sems, ks_buf=None, vs_buf=None):
         def dma(buf_hbm, scr, slot, blk, sem_idx):
             return pltpu.make_async_copy(
                 buf_hbm.at[b, pl.ds(blk * bk, bk)],
@@ -74,8 +78,21 @@ def _mq_kernel(
                 sems.at[slot, sem_idx],
             )
 
-        dma(k_hbm, k_buf, 0, start_blk, 0).start()
-        dma(v_hbm, v_buf, 0, start_blk, 1).start()
+        def start_all(slot, blk):
+            dma(k_hbm, k_buf, slot, blk, 0).start()
+            dma(v_hbm, v_buf, slot, blk, 1).start()
+            if quantized:
+                dma(ks_hbm, ks_buf, slot, blk, 2).start()
+                dma(vs_hbm, vs_buf, slot, blk, 3).start()
+
+        def wait_all(slot, blk):
+            dma(k_hbm, k_buf, slot, blk, 0).wait()
+            dma(v_hbm, v_buf, slot, blk, 1).wait()
+            if quantized:
+                dma(ks_hbm, ks_buf, slot, blk, 2).wait()
+                dma(vs_hbm, vs_buf, slot, blk, 3).wait()
+
+        start_all(0, start_blk)
 
         def loop(i, carry):
             m, l, acc = carry  # [KH*T*G, 1], [KH*T*G, 1], [KH*T*G, D]
@@ -83,14 +100,13 @@ def _mq_kernel(
 
             @pl.when(i + 1 < n_blk)
             def _prefetch():
-                nxt = 1 - slot
-                dma(k_hbm, k_buf, nxt, i + 1, 0).start()
-                dma(v_hbm, v_buf, nxt, i + 1, 1).start()
+                start_all(1 - slot, i + 1)
 
-            dma(k_hbm, k_buf, slot, i, 0).wait()
-            dma(v_hbm, v_buf, slot, i, 1).wait()
+            wait_all(slot, i)
             kb = k_buf[slot]  # [bk, KH*D]
             vb = v_buf[slot]
+            ksb = ks_buf[slot] if quantized else None  # [bk, KH] f32
+            vsb = vs_buf[slot] if quantized else None
 
             cols = i * bk + jax.lax.broadcasted_iota(jnp.int32, (T, bk), 1)
             valid = cols <= qpos[:, None]  # causal staircase per query
@@ -103,10 +119,14 @@ def _mq_kernel(
             for h in range(KH):
                 qh = q[:, h * G : (h + 1) * G, :].reshape(T * G, D)
                 kh = kb[:, h * D : (h + 1) * D]
+                if quantized:
+                    kh = kh.astype(jnp.float32)
                 s = jax.lax.dot_general(
                     qh, kh, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )  # [T*G, bk]
+                if quantized:
+                    s = s * ksb[:, h][None, :]
                 parts.append(jnp.where(validg, s, NEG_INF))
             s_all = jnp.concatenate(parts, axis=0)  # [KH*T*G, bk]
 
@@ -121,8 +141,14 @@ def _mq_kernel(
 
             outs = []
             for h in range(KH):
-                ph = p[h * T * G : (h + 1) * T * G, :].astype(vb.dtype)
+                ph = p[h * T * G : (h + 1) * T * G, :]
+                if quantized:
+                    ph = ph * vsb[:, h][None, :]
+                else:
+                    ph = ph.astype(vb.dtype)
                 vh = vb[:, h * D : (h + 1) * D]
+                if quantized:
+                    vh = vh.astype(jnp.float32)
                 outs.append(
                     jax.lax.dot_general(
                         ph, vh, (((1,), (0,)), ((), ())),
@@ -143,12 +169,69 @@ def _mq_kernel(
         out = out.reshape(KH, T, G, D).transpose(1, 0, 2, 3)
         o_ref[0] = out.reshape(T, H, D).astype(o_ref.dtype)
 
-    pl.run_scoped(
-        body,
-        k_buf=pltpu.VMEM((2, bk, KH * D), k_hbm.dtype),
-        v_buf=pltpu.VMEM((2, bk, KH * D), v_hbm.dtype),
-        sems=pltpu.SemaphoreType.DMA((2, 2)),
+    if quantized:
+        pl.run_scoped(
+            body,
+            k_buf=pltpu.VMEM((2, bk, KH * D), jnp.int8),
+            v_buf=pltpu.VMEM((2, bk, KH * D), jnp.int8),
+            sems=pltpu.SemaphoreType.DMA((2, 4)),
+            ks_buf=pltpu.VMEM((2, bk, KH), jnp.float32),
+            vs_buf=pltpu.VMEM((2, bk, KH), jnp.float32),
+        )
+    else:
+        pl.run_scoped(
+            body,
+            k_buf=pltpu.VMEM((2, bk, KH * D), k_hbm.dtype),
+            v_buf=pltpu.VMEM((2, bk, KH * D), v_hbm.dtype),
+            sems=pltpu.SemaphoreType.DMA((2, 2)),
+        )
+
+
+def _mq_call(q, k_cache, v_cache, lengths, strides, scales, *, window,
+             block_kv, interpret):
+    """Shared pallas_call plumbing for both cache dtypes."""
+    from .decode_attention import pick_block_kv
+
+    B, T, H, D = q.shape
+    C, KH = k_cache.shape[1], k_cache.shape[2]
+    bk = pick_block_kv(C) if block_kv is None else min(block_kv, C)
+    if C % bk:
+        raise ValueError(f"block_kv {bk} must evenly divide cache length {C}")
+    quantized = scales is not None
+    kernel = functools.partial(
+        _mq_kernel,
+        num_kv_heads=KH,
+        head_dim=D,
+        block_kv=bk,
+        window=window,
+        sm_scale=1.0 / float(np.sqrt(D)),
+        quantized=quantized,
     )
+    cache_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * (
+        2 + (2 if quantized else 0)
+    )
+    args = [
+        lengths.astype(jnp.int32),
+        strides.astype(jnp.int32),
+        q,
+        k_cache.reshape(B, C, KH * D),
+        v_cache.reshape(B, C, KH * D),
+    ]
+    if quantized:
+        args.extend(scales)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # strides
+            pl.BlockSpec((1, T, H, D), lambda b: (b, 0, 0, 0)),
+            *cache_specs,
+        ],
+        out_specs=pl.BlockSpec((1, T, H, D), lambda b: (b, 0, 0, 0)),
+        interpret=interpret,
+    )(*args)
 
 
 @functools.partial(
@@ -166,41 +249,53 @@ def multiquery_decode_attention(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Ragged multi-query decode attention; returns [B, T, H, D]."""
-    from .decode_attention import pick_block_kv
-
-    B, T, H, D = q.shape
-    C, KH = k_cache.shape[1], k_cache.shape[2]
-    bk = pick_block_kv(C) if block_kv is None else min(block_kv, C)
-    if C % bk:
-        raise ValueError(f"block_kv {bk} must evenly divide cache length {C}")
-
-    kernel = functools.partial(
-        _mq_kernel,
-        num_kv_heads=KH,
-        head_dim=D,
-        block_kv=bk,
-        window=window,
-        sm_scale=1.0 / float(np.sqrt(D)),
+    return _mq_call(
+        q, k_cache, v_cache, lengths, strides, None,
+        window=window, block_kv=block_kv, interpret=interpret,
     )
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # strides
-            pl.BlockSpec((1, T, H, D), lambda b: (b, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, T, H, D), lambda b: (b, 0, 0, 0)),
-        interpret=interpret,
-    )(
-        lengths.astype(jnp.int32),
-        strides.astype(jnp.int32),
-        q,
-        k_cache.reshape(B, C, KH * D),
-        v_cache.reshape(B, C, KH * D),
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "block_kv", "interpret")
+)
+def multiquery_decode_attention_int8(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k_cache: jnp.ndarray,  # [B, C, KH, D] int8
+    v_cache: jnp.ndarray,  # [B, C, KH, D] int8
+    k_scales: jnp.ndarray,  # [B, C, KH] f32
+    v_scales: jnp.ndarray,  # [B, C, KH] f32
+    lengths: jnp.ndarray,  # [B] int32
+    strides: jnp.ndarray,  # [B] int32
+    *,
+    window: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Multi-query ragged attention over an INT8 KV cache: the cache
+    streams as int8 with per-(row, kv-head) scales folded into the
+    score/value dots — speculative verify at half the cache bandwidth."""
+    return _mq_call(
+        q, k_cache, v_cache, lengths, strides, (k_scales, v_scales),
+        window=window, block_kv=block_kv, interpret=interpret,
+    )
+
+
+def multiquery_decode_attention_int8_reference(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [B, C, KH, D] int8
+    v_cache: jnp.ndarray,
+    k_scales: jnp.ndarray,  # [B, C, KH] f32
+    v_scales: jnp.ndarray,
+    lengths: jnp.ndarray,
+    strides: jnp.ndarray,
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Dequantize-then-attend ground truth for the int8 mq kernel."""
+    kf = k_cache.astype(jnp.float32) * k_scales[..., None]
+    vf = v_cache.astype(jnp.float32) * v_scales[..., None]
+    return multiquery_decode_attention_reference(
+        q, kf, vf, lengths, strides, window=window
     )
 
 

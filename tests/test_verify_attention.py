@@ -136,3 +136,71 @@ def test_verify_step_kernel_branch_matches_masked(monkeypatch):
     )
     np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), rtol=1e-5,
                                atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# int8-KV multi-query kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_multiquery_int8_parity(window):
+    from aios_tpu.ops import (
+        multiquery_decode_attention_int8,
+        multiquery_decode_attention_int8_reference,
+    )
+
+    rng = np.random.default_rng(11)
+    B, T, H, KH, D, C = 3, 4, 8, 2, 16, 64
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.integers(-127, 128, (B, C, KH, D)), jnp.int8)
+    v = jnp.asarray(rng.integers(-127, 128, (B, C, KH, D)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, (B, C, KH)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, (B, C, KH)), jnp.float32)
+    lens = jnp.asarray([0, 31, 57], jnp.int32)
+    strides = jnp.asarray([1, 1, 0], jnp.int32)
+    got = multiquery_decode_attention_int8(
+        q, k, v, ks, vs, lens, strides, window=window, block_kv=16,
+        interpret=True,
+    )
+    ref = multiquery_decode_attention_int8_reference(
+        q, k, v, ks, vs, lens, strides, window=window
+    )
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_verify_step_int8_kernel_wiring(monkeypatch):
+    """AIOS_TPU_INT8_RAGGED=1 routes int8-KV verify through the mq kernel
+    (reference body on CPU); outputs match the dequantizing XLA path."""
+    import aios_tpu.ops as ops_mod
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    lens = jnp.asarray([5, 9], jnp.int32)
+    k, v = M.init_kv_cache(cfg, 2, 32, jnp.int8)
+    scales = M.init_kv_scales(cfg, 2, 32)
+
+    ref = M.verify_step(
+        params, cfg, toks, lens, k, v, kernels=False, cache_scales=scales,
+    )[0]
+
+    called = {}
+
+    def fake(q, k_l, v_l, k_s, v_s, base, strides, window=None):
+        called["hit"] = True
+        return ops_mod.multiquery_decode_attention_int8_reference(
+            q, k_l, v_l, k_s, v_s, base, strides, window=window
+        )
+
+    monkeypatch.setenv("AIOS_TPU_INT8_RAGGED", "1")
+    monkeypatch.setenv("AIOS_TPU_RAGGED_MIN_C", "1")
+    monkeypatch.setattr(ops_mod, "multiquery_decode_attention_int8", fake)
+    got = M.verify_step(
+        params, cfg, toks, lens, k, v, kernels=True, cache_scales=scales,
+    )[0]
+    assert called.get("hit")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
