@@ -403,3 +403,52 @@ def test_guided_toolcalls_end_to_end(monkeypatch):
         assert all(c in catalog for c in calls_made)
     finally:
         server.stop(0)
+
+
+def test_schema_through_gateway_to_runtime_sockets():
+    """Two live services: ApiGateway.Infer (json_schema field) -> local
+    provider -> AIRuntime gRPC -> grammar-guided engine. The full
+    cross-service structured-output path the guided autonomy loop rides."""
+    from aios_tpu import rpc, services
+    from aios_tpu.gateway.router import RequestRouter
+    from aios_tpu.gateway.service import serve as serve_gateway
+    from aios_tpu.proto_gen import api_gateway_pb2, runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve as serve_runtime
+
+    schema = json.dumps({
+        "type": "object",
+        "properties": {"status": {"type": "string", "enum": ["ok", "error"]}},
+        "required": ["status"],
+    })
+    manager = ModelManager(num_slots=2, warm_compile=False)
+    rt_server, _s, rt_port = serve_runtime(
+        address="127.0.0.1:0", manager=manager, block=False
+    )
+    gw_server = None
+    try:
+        stub = services.AIRuntimeStub(
+            rpc.insecure_channel(f"127.0.0.1:{rt_port}")
+        )
+        r = stub.LoadModel(runtime_pb2.LoadModelRequest(
+            model_name="tiny", model_path="synthetic://tiny-test",
+            context_length=256,
+        ))
+        assert r.status == "ready"
+        router = RequestRouter(runtime_address=f"127.0.0.1:{rt_port}")
+        gw_server, _gs, gw_port = serve_gateway(
+            address="127.0.0.1:0", router=router, block=False
+        )
+        gw = services.ApiGatewayStub(
+            rpc.insecure_channel(f"127.0.0.1:{gw_port}")
+        )
+        resp = gw.Infer(api_gateway_pb2.ApiInferRequest(
+            prompt="status?", max_tokens=32, temperature=1.0,
+            preferred_provider="local", json_schema=schema,
+        ))
+        obj = json.loads(resp.text)
+        assert obj["status"] in ("ok", "error"), resp.text
+    finally:
+        if gw_server is not None:
+            gw_server.stop(0)
+        rt_server.stop(0)
