@@ -488,6 +488,67 @@ def bench_moe_gather():
     }
 
 
+def bench_int8_kv_ragged_ab():
+    """A/B the env-gated int8-KV ragged kernel (AIOS_TPU_INT8_RAGGED) on a
+    long-context int8-KV TinyLlama: flag OFF = the dequantizing XLA
+    full-cache read, flag ON = int8 pages stream through the Pallas kernel
+    with valid-rows-only DMA. The flag is read at trace time, so each arm
+    builds a fresh engine. This is the measurement the kernel family is
+    gated on (docs/HARDWARE.md 'pending chip measurement')."""
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.config import TINYLLAMA_1_1B
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINYLLAMA_1_1B
+    params = model_mod.init_quantized_params(cfg, jax.random.PRNGKey(0))
+    chunk, rounds, ctx = 64, 2, 4096
+    results = {}
+    prior = os.environ.get("AIOS_TPU_INT8_RAGGED")
+    try:
+        for arm, flag in (("xla_dequant", ""), ("int8_ragged_kernel", "1")):
+            if flag:
+                os.environ["AIOS_TPU_INT8_RAGGED"] = flag
+            else:
+                os.environ.pop("AIOS_TPU_INT8_RAGGED", None)
+            eng = TPUEngine(cfg, params, num_slots=8, max_context=ctx,
+                            cache_dtype=jnp.int8)
+            # mid-length caches so the ragged DMA win is visible
+            for s_ in range(8):
+                eng.prefill(s_, list(range(1, 1025)), temperature=0.7,
+                            top_p=0.95)
+            eng.step(chunk)  # compile
+            eng.step(chunk)  # warm
+            t0 = time.time()
+            for _ in range(rounds):
+                eng.step(chunk)
+            dt = time.time() - t0
+            eng.close()
+            results[arm] = 8 * chunk * rounds / dt
+            log(f"[int8-ragged-ab] {arm}: {results[arm]:.1f} tok/s")
+    finally:
+        if prior is None:
+            os.environ.pop("AIOS_TPU_INT8_RAGGED", None)
+        else:
+            os.environ["AIOS_TPU_INT8_RAGGED"] = prior
+    speedup = results["int8_ragged_kernel"] / max(
+        results["xla_dequant"], 1e-9
+    )
+    return {
+        "metric": "int8-KV ragged kernel A/B, tinyllama 8 slots @ 1k/4096 "
+                  "ctx (env-gated kernel vs XLA dequant path)",
+        "value": round(results["int8_ragged_kernel"], 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(
+            results["int8_ragged_kernel"] / BASELINE_CPU_TPS, 1
+        ),
+        "xla_dequant_tok_per_s": round(results["xla_dequant"], 1),
+        "kernel_speedup": round(speedup, 2),
+    }
+
+
 def _force_virtual_cpu_mesh(n: int = 8):
     """Point this process at an n-device virtual CPU mesh (a site hook in
     this image can re-force the TPU platform after import, hence both the
@@ -654,7 +715,10 @@ def main() -> int:
                 "error": repr(e)[:300],
             })
     extra = [] if args.skip_mistral else [bench_mixed_tier, bench_spec_decode]
-    extra.extend([bench_paged_kv, bench_agent_ttft, bench_moe_gather])
+    extra.extend([
+        bench_paged_kv, bench_agent_ttft, bench_moe_gather,
+        bench_int8_kv_ragged_ab,
+    ])
     for fn in extra:
         try:
             emit(fn())
