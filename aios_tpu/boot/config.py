@@ -37,6 +37,14 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             "num_slots": 8,
             "warm_compile": True,
             "autoload": True,
+            # TPU serving knobs -> AIOS_TPU_* env for the runtime child
+            # (serving_env(); docs/CONFIG.md documents each)
+            "quantize": "",          # "" = auto; "0"/"1" force
+            "kv_cache": "",          # "int8" halves KV footprint/traffic
+            "paged_kv_rows": 0,      # >0 = paged pool with this row budget
+            "speculative": False,    # n-gram speculative decode
+            "json_mode": "",         # "force" = reference json_object parity
+            "guided_toolcalls": False,  # schema-guided reasoning replies
         },
         "api": {
             "claude_model": "claude-sonnet-4-20250514",
@@ -114,3 +122,50 @@ def load_config(path: str | None = None) -> AiosConfig:
         except (OSError, ValueError):
             pass
     return AiosConfig(sections=sections, source_path=source)
+
+
+def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
+    """Translate [models] serving knobs into the AIOS_TPU_* env the
+    runtime/gateway/orchestrator children read (docs/CONFIG.md) — the
+    boot-config analog of the reference's config.toml -> llama-server
+    flag plumbing (initd/src/config.rs:14-34).
+
+    Env beats config (the convention everywhere in this codebase): a knob
+    the operator already exported is NOT injected, so config supplies
+    defaults without clobbering an explicit override. A malformed value
+    warns and is skipped — one bad tuning knob must not take down boot
+    (the lenient pattern of model_manager's env parsers).
+    """
+    import logging
+
+    log = logging.getLogger("aios.boot.config")
+    m = cfg.section("models")
+    env: Dict[str, str] = {}
+
+    def put(key: str, value: str) -> None:
+        if key in os.environ:
+            log.info("%s already set in env; config value ignored", key)
+        else:
+            env[key] = value
+
+    if str(m.get("quantize", "")) != "":
+        put("AIOS_TPU_QUANTIZE", str(m["quantize"]))
+    if m.get("kv_cache"):
+        put("AIOS_TPU_KV_CACHE", str(m["kv_cache"]))
+    try:
+        rows = int(m.get("paged_kv_rows", 0) or 0)
+    except (TypeError, ValueError):
+        log.warning(
+            "[models] paged_kv_rows=%r is not an integer; ignored",
+            m.get("paged_kv_rows"),
+        )
+        rows = 0
+    if rows > 0:
+        put("AIOS_TPU_PAGED_KV", str(rows))
+    if m.get("speculative"):
+        put("AIOS_TPU_SPECULATIVE", "1")
+    if m.get("json_mode"):
+        put("AIOS_TPU_JSON_MODE", str(m["json_mode"]))
+    if m.get("guided_toolcalls"):
+        put("AIOS_TPU_GUIDED_TOOLCALLS", "1")
+    return env

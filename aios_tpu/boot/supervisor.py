@@ -43,22 +43,32 @@ class ServiceDef:
     env: Dict[str, str] = field(default_factory=dict)
 
 
-def default_services() -> Dict[str, ServiceDef]:
+def default_services(config=None) -> Dict[str, ServiceDef]:
+    """The five-service topology. With a boot ``config``, the [models]
+    serving knobs translate into AIOS_TPU_* env for every child
+    (boot/config.serving_env) — one TOML section drives the whole stack's
+    serving mode, like the reference's config.toml -> service flags."""
     from ..services import DEFAULT_PORTS
 
+    env: Dict[str, str] = {}
+    if config is not None:
+        from .config import serving_env
+
+        env = serving_env(config)
     return {
         "runtime": ServiceDef("runtime", "aios_tpu.runtime.service",
-                              DEFAULT_PORTS["runtime"]),
+                              DEFAULT_PORTS["runtime"], env=dict(env)),
         "memory": ServiceDef("memory", "aios_tpu.memory.service",
-                             DEFAULT_PORTS["memory"]),
+                             DEFAULT_PORTS["memory"], env=dict(env)),
         "tools": ServiceDef("tools", "aios_tpu.tools.service",
-                            DEFAULT_PORTS["tools"]),
+                            DEFAULT_PORTS["tools"], env=dict(env)),
         "gateway": ServiceDef("gateway", "aios_tpu.gateway.service",
-                              DEFAULT_PORTS["gateway"]),
+                              DEFAULT_PORTS["gateway"], env=dict(env)),
         "orchestrator": ServiceDef(
             "orchestrator", "aios_tpu.orchestrator.main",
             DEFAULT_PORTS["orchestrator"],
             deps=["runtime", "memory", "tools", "gateway"],
+            env=dict(env),
         ),
     }
 
@@ -101,7 +111,9 @@ class Supervisor:
         services: Optional[Dict[str, ServiceDef]] = None,
     ):
         self.config = config or load_config()
-        self.services = services or default_services()
+        # default topology picks up the config's [models] serving knobs
+        # (serving_env) so the TOML drives the whole stack's serving mode
+        self.services = services or default_services(self.config)
         self.supervised: Dict[str, Supervised] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -122,3 +122,57 @@ def test_boot_health_restart_and_clean_shutdown(tmp_path):
     for entry in sup.supervised.values():
         if entry.process is not None:
             assert entry.process.poll() is not None
+
+
+def test_serving_env_from_boot_config(tmp_path):
+    """[models] serving knobs translate into AIOS_TPU_* env for every
+    child service (one TOML section drives the stack's serving mode)."""
+    from aios_tpu.boot.config import load_config, serving_env
+    from aios_tpu.boot.supervisor import default_services
+
+    cfg_file = tmp_path / "config.toml"
+    cfg_file.write_text(
+        "[models]\n"
+        "kv_cache = \"int8\"\n"
+        "paged_kv_rows = 8192\n"
+        "speculative = true\n"
+        "json_mode = \"force\"\n"
+        "guided_toolcalls = true\n"
+        "quantize = \"1\"\n"
+    )
+    cfg = load_config(str(cfg_file))
+    env = serving_env(cfg)
+    assert env == {
+        "AIOS_TPU_QUANTIZE": "1",
+        "AIOS_TPU_KV_CACHE": "int8",
+        "AIOS_TPU_PAGED_KV": "8192",
+        "AIOS_TPU_SPECULATIVE": "1",
+        "AIOS_TPU_JSON_MODE": "force",
+        "AIOS_TPU_GUIDED_TOOLCALLS": "1",
+    }
+    defs = default_services(cfg)
+    for d in defs.values():
+        assert d.env["AIOS_TPU_KV_CACHE"] == "int8"
+
+    # defaults: no knobs set -> no env injected (AiosConfig() directly;
+    # load_config(None) would read this HOST's /etc/aios config)
+    from aios_tpu.boot.config import AiosConfig
+
+    assert serving_env(AiosConfig()) == {}
+    assert default_services()["runtime"].env == {}
+
+    # env beats config: an operator-exported knob is not clobbered
+    import os
+
+    os.environ["AIOS_TPU_KV_CACHE"] = "bf16"
+    try:
+        assert "AIOS_TPU_KV_CACHE" not in serving_env(cfg)
+        assert serving_env(cfg)["AIOS_TPU_JSON_MODE"] == "force"
+    finally:
+        del os.environ["AIOS_TPU_KV_CACHE"]
+
+    # malformed paged_kv_rows warns and is skipped, not fatal
+    bad = tmp_path / "bad.toml"
+    bad.write_text('[models]\npaged_kv_rows = "64k"\n')
+    env2 = serving_env(load_config(str(bad)))
+    assert "AIOS_TPU_PAGED_KV" not in env2
