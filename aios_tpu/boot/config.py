@@ -39,7 +39,7 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             "autoload": True,
             # TPU serving knobs -> AIOS_TPU_* env for the runtime child
             # (serving_env(); docs/CONFIG.md documents each)
-            "quantize": "",          # "" = auto; "0"/"1" force
+            "quantize": "",          # "" = auto; "0"/"1"/"int8"/"int4"
             "kv_cache": "",          # "int8" halves KV footprint/traffic
             "paged_kv_rows": 0,      # >0 = paged pool with this row budget
             "speculative": False,    # n-gram speculative decode

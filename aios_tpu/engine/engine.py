@@ -24,6 +24,7 @@ price of a fixed-shape graph and it is what keeps XLA fast.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -33,6 +34,8 @@ import numpy as np
 
 from . import model, paged, sampling, spec
 from .config import ModelConfig
+
+log = logging.getLogger("aios.engine")
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
@@ -54,7 +57,7 @@ class TPUEngine:
         cache_dtype=jnp.bfloat16,
         seed: int = 0,
         shardings=None,  # optional ShardingPlan (aios_tpu.parallel.sharding)
-        quantize: bool = False,  # int8 serving weights
+        quantize=False,  # serving weights: False/True/"int8"/"int4"
         sharded_attention: Optional[bool] = None,  # shard_map ragged decode
         paged_pool_rows: Optional[int] = None,  # physical KV rows -> paged
         page_size: int = 128,
@@ -69,7 +72,26 @@ class TPUEngine:
         ) or (self.max_context,)
         self._lock = threading.Lock()
         self.plan = shardings
-        self.quantized = bool(quantize)
+        # normalize the quantize knob to a mode: True -> int8 (the measured
+        # single-chip default), "int4" -> packed-nibble group-wise int4
+        # (ops/int4_matmul.py; half the int8 weight bytes). int4 is a
+        # per-device Pallas streaming path, so under a sharding plan (where
+        # matmuls are GSPMD-partitioned XLA dots) it downgrades to int8
+        # rather than serve a dequantize-in-HBM graph.
+        if quantize is True:
+            quantize = "int8"
+        elif not quantize:
+            quantize = None
+        elif quantize not in ("int8", "int4"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        if quantize == "int4" and shardings is not None:
+            log.warning(
+                "int4 serving is a single-chip Pallas path; sharded plan "
+                "serves int8 instead"
+            )
+            quantize = "int8"
+        self.quant_mode = quantize
+        self.quantized = quantize is not None
         # int8 KV cache: half the cache footprint/traffic; scales ride along
         # in the decode state and rows quantize on write inside the graph
         self.quant_cache = cache_dtype == jnp.int8
@@ -106,7 +128,9 @@ class TPUEngine:
         else:
             self.params = jax.tree.map(jnp.asarray, params)
             if quantize:
-                self.params = model.quantize_params(self.params)
+                self.params = model.quantize_params(
+                    self.params, mode=quantize
+                )
 
         # Context-sharded KV: the cache's C axis splits over the mesh's sp
         # axis, so one slot's KV can exceed a single chip's HBM — XLA

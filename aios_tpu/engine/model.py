@@ -50,12 +50,30 @@ QUANT_KEYS = (
 
 
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """x @ w where w is either a dense array or an int8 leaf {"q", "s"}.
+    """x @ w where w is a dense array, an int8 leaf {"q", "s"} or an int4
+    leaf {"q4", "s4"}.
 
-    Quantized leaves stream int8 from HBM through the Pallas kernel on TPU
-    (half the decode bandwidth of bf16); elsewhere they dequantize inline.
+    Quantized leaves stream their narrow format from HBM (int8 via XLA's
+    mixed dot or the Pallas qmm; int4 via the packed-nibble Pallas kernel —
+    a quarter of the bf16 decode bandwidth); elsewhere they dequantize
+    inline.
     """
     if isinstance(w, dict):
+        if "q4" in w:
+            from ..ops.int4_matmul import (
+                infer_group,
+                int4_matmul,
+                int4_matmul_reference,
+                kernel_supported,
+            )
+
+            p4, s4 = w["q4"], w["s4"]
+            g = infer_group(p4, s4)
+            if ops.use_pallas() and kernel_supported(
+                p4.shape[-2] * 2, p4.shape[-1], g
+            ):
+                return int4_matmul(x, p4, s4)
+            return int4_matmul_reference(x, p4, s4)
         w_q, s = w["q"], w["s"]
         if ops.use_pallas():
             import os
@@ -82,7 +100,8 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
 
 
 def quantize_params(
-    params: Params, include_head: bool = True, fuse: bool = True
+    params: Params, include_head: bool = True, fuse: bool = True,
+    mode: str = "int8",
 ) -> Params:
     """Convert matmul weights to int8 serving leaves {"q": int8, "s": f32}.
 
@@ -102,7 +121,16 @@ def quantize_params(
 
     Norms and the embedding gather stay bf16 (negligible bandwidth). The
     dense layout is untouched — training and sharding plans use it.
+
+    ``mode="int4"`` emits group-wise int4 leaves {"q4": packed nibbles,
+    "s4": [G, 1, N] scales} instead (ops/int4_matmul.py) — half the int8
+    bytes, matching the reference's Q4-class GGUF serving precision.
+    Leaves whose dims don't fit the int4 layout, and the expert-stacked
+    MoE leaves (their gathered-decode path is int8-specialized), fall
+    back to int8.
     """
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown weight quantization mode {mode!r}")
     out = dict(params)
     src = params["layers"]
     layers = {
@@ -122,16 +150,24 @@ def quantize_params(
             to_quant += (("w_gateup", gateup), ("w_down", src["w_down"]))
     else:
         to_quant = tuple((k, src[k]) for k in QUANT_KEYS if k in src)
-    for key, w in to_quant:
+    def quant_leaf(key, w):
+        if mode == "int4" and not key.startswith("we_"):
+            from ..ops.int4_matmul import quantize_int4, supports_int4
+
+            if supports_int4(w.shape[-2], w.shape[-1]):
+                p, s = quantize_int4(w)
+                return {"q4": p, "s4": s}
         q, s = ops.quantize_int8(w, axis=-2)
-        layers[key] = {"q": q, "s": s}
+        return {"q": q, "s": s}
+
+    for key, w in to_quant:
+        layers[key] = quant_leaf(key, w)
     out["layers"] = layers
     if include_head:
         head = params.get("lm_head")
         if head is None:
             head = params["embed"].T
-        q, s = ops.quantize_int8(head, axis=-2)
-        out["lm_head"] = {"q": q, "s": s}
+        out["lm_head"] = quant_leaf("lm_head", head)
     return out
 
 
@@ -1263,21 +1299,34 @@ def init_params(
 
 
 def init_quantized_params(
-    cfg: ModelConfig, key: jax.Array, fuse: bool = True, dtype=jnp.bfloat16
+    cfg: ModelConfig, key: jax.Array, fuse: bool = True, dtype=jnp.bfloat16,
+    mode: str = "int8",
 ) -> Params:
-    """Random params built DIRECTLY in the int8 serving layout
+    """Random params built DIRECTLY in the quantized serving layout
     (``quantize_params`` output shapes) — the bf16 weights never
-    materialize, so a 7B model inits in ~7 GB of HBM instead of ~22 GB.
-    Benchmarks and dry-runs only: decode throughput is weight-value-
-    independent (same bytes streamed, same FLOPs), and each quantized
-    tensor tiles one random 2-D block over the layer axis to keep the
-    init's own peak memory at one layer's worth.
+    materialize, so a 7B model inits in ~7 GB of HBM instead of ~22 GB
+    (int4: ~3.5 GB). Benchmarks and dry-runs only: decode throughput is
+    weight-value-independent (same bytes streamed, same FLOPs), and each
+    quantized tensor tiles one random 2-D block over the layer axis to
+    keep the init's own peak memory at one layer's worth.
     """
     keys = iter(jax.random.split(key, 16))
     L, E, F, D = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
     V = cfg.vocab_size
 
-    def qleaf(shape):
+    def qleaf(shape, force_int8: bool = False):
+        if mode == "int4" and not force_int8:
+            from ..ops.int4_matmul import pick_group, supports_int4
+
+            K, N = shape[-2], shape[-1]
+            if supports_int4(K, N):
+                g = pick_group(K)
+                block = jax.random.randint(
+                    next(keys), (K // 2, N), 0, 256, jnp.int32
+                ).astype(jnp.uint8)
+                q = jnp.asarray(jnp.broadcast_to(block, shape[:-2] + (K // 2, N)))
+                s_shape = shape[:-2] + (K // g, 1, N)
+                return {"q4": q, "s4": jnp.full(s_shape, 0.02 / 7.0, jnp.float32)}
         block = jax.random.randint(
             next(keys), shape[-2:], -127, 128, jnp.int32
         ).astype(jnp.int8)
@@ -1305,13 +1354,15 @@ def init_quantized_params(
         layers["w_router"] = (
             jax.random.normal(next(keys), (L, E, X), jnp.float32) * 0.02
         ).astype(dtype)
+        # expert leaves stay int8 in int4 mode (the gathered-expert decode
+        # path is int8-specialized, matching quantize_params)
         if fuse:
-            layers["we_gateup"] = qleaf((L, X, E, 2 * Fm))
-            layers["we_down"] = qleaf((L, X, Fm, E))
+            layers["we_gateup"] = qleaf((L, X, E, 2 * Fm), force_int8=True)
+            layers["we_down"] = qleaf((L, X, Fm, E), force_int8=True)
         else:
-            layers["we_gate"] = qleaf((L, X, E, Fm))
-            layers["we_up"] = qleaf((L, X, E, Fm))
-            layers["we_down"] = qleaf((L, X, Fm, E))
+            layers["we_gate"] = qleaf((L, X, E, Fm), force_int8=True)
+            layers["we_up"] = qleaf((L, X, E, Fm), force_int8=True)
+            layers["we_down"] = qleaf((L, X, Fm, E), force_int8=True)
     elif fuse:
         layers["w_gateup"] = qleaf((L, E, 2 * F))
         layers["w_down"] = qleaf((L, F, E))

@@ -42,6 +42,12 @@ from .paged_attention import (
     paged_decode_attention_int8_reference,
     paged_decode_attention_reference,
 )
+from .int4_matmul import (
+    dequantize_int4,
+    int4_matmul,
+    int4_matmul_reference,
+    quantize_int4,
+)
 from .quantized_matmul import (
     dequantize,
     quantize_int8,
@@ -75,6 +81,10 @@ __all__ = [
     "dequantize",
     "quantized_matmul",
     "quantized_matmul_reference",
+    "quantize_int4",
+    "dequantize_int4",
+    "int4_matmul",
+    "int4_matmul_reference",
     "use_pallas",
 ]
 

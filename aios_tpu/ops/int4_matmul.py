@@ -1,0 +1,230 @@
+"""Int4-weight matmul: packed nibbles stream from HBM, dequantize in VMEM.
+
+The reference's production weight format is GGUF Q4_K_M — ~4.5 bits per
+weight with group-wise scales (SURVEY.md section 7 hard-part #2). This
+module is the serving-time equivalent for the TPU decode path: symmetric
+int4 with one scale per ``group`` rows of the contraction dim per output
+channel, which quarters the weight bytes streamed per decode step relative
+to bf16 (and halves them relative to the int8 path in
+``quantized_matmul.py``). Batched decode is HBM-bandwidth-bound on exactly
+those bytes, so this is a direct throughput lever for the 7B tier.
+
+Storage: two weight rows pack into one byte — within each group of
+``group`` rows, byte ``r`` holds row ``r`` in the low nibble and row
+``r + group/2`` in the high nibble, both offset-binary (q+8 in [0, 15]).
+This split-half layout lets the kernel unpack a [group/2, N] byte tile
+into a [group, N] int tile with a single sublane concatenate — no
+interleave shuffle. Scales are one f32 per (group, output channel).
+
+Native ``jnp.int4`` arrays are not used: this JAX build's int4 path is
+unreliable on the CPU backend (array creation recurses), and packed uint8
+gives the same HBM bytes with full control over the unpack.
+
+The Pallas kernel dequantizes tile-by-tile in VMEM (scale applied on the
+weight tile, one MXU dot per K-block); the jnp reference implementation is
+the CPU fallback and the parity ground truth, mirroring the module layout
+of ``quantized_matmul.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows of the contraction dim per scale. 128 divides every matmul dim of
+# every supported model tier (engine/config.py) and matches the kernel's
+# minimum K block, so each weight tile sees whole groups.
+GROUP = 128
+
+
+def pick_group(K: int) -> int:
+    """Largest supported scale-group size dividing K (0 if none).
+
+    128 everywhere it fits (it divides every matmul dim of every real
+    model tier); smaller power-of-two groups keep the tiny test geometries
+    on the same storage format via the jnp reference path.
+    """
+    for g in (GROUP, 64, 32, 16):
+        if K % g == 0:
+            return g
+    return 0
+
+
+def supports_int4(K: int, N: int, group: int = None) -> bool:
+    """Whether a [K, N] weight can take the int4 serving *storage* layout."""
+    g = pick_group(K) if group is None else group
+    return g != 0 and K % g == 0 and g % 2 == 0
+
+
+def kernel_supported(K: int, N: int, group: int) -> bool:
+    """Whether the Pallas kernel can serve this layout (alignment: the
+    K block equals the scale group, and both tiling dims are 128-lane)."""
+    return group % GROUP == 0 and N % 128 == 0 and K % group == 0
+
+
+def quantize_int4(
+    w: jnp.ndarray, group: int = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Group-wise symmetric int4 quantization along the contraction dim.
+
+    For ``w`` [..., K, N] returns (packed uint8 [..., K/2, N],
+    scales f32 [..., K/group, 1, N]). Leading batch axes (stacked layers,
+    stacked experts) pass through.
+    """
+    *lead, K, N = w.shape
+    if group is None:
+        group = pick_group(K)
+    if not supports_int4(K, N, group):
+        raise ValueError(f"no int4 group layout for weight shape {w.shape}")
+    wf = w.astype(jnp.float32).reshape(*lead, K // group, group, N)
+    absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -8, 7).astype(jnp.int32)
+    # split-half packing within each group: low nibble rows [0, g/2),
+    # high nibble rows [g/2, g)
+    q = (q + 8).astype(jnp.uint8).reshape(*lead, K // group, 2, group // 2, N)
+    packed = q[..., 0, :, :] | (q[..., 1, :, :] << 4)
+    return packed.reshape(*lead, K // 2, N), scale.astype(jnp.float32)
+
+
+def unpack_int4(packed: jnp.ndarray, group: int = GROUP) -> jnp.ndarray:
+    """Packed uint8 [..., K/2, N] -> int8 [..., K, N] (no scales applied)."""
+    *lead, Kh, N = packed.shape
+    K = Kh * 2
+    p = packed.reshape(*lead, K // group, group // 2, N).astype(jnp.int32)
+    lo = (p & 0xF) - 8
+    hi = (p >> 4) - 8
+    w = jnp.concatenate([lo, hi], axis=-2)  # [..., K/group, group, N]
+    return w.reshape(*lead, K, N).astype(jnp.int8)
+
+
+def infer_group(packed: jnp.ndarray, scale: jnp.ndarray) -> int:
+    """Recover the scale-group size from the leaf shapes (no metadata)."""
+    return packed.shape[-2] * 2 // scale.shape[-3]
+
+
+def dequantize_int4(
+    packed: jnp.ndarray,
+    scale: jnp.ndarray,
+    group: int = None,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Full dequantization (load/conversion paths, not the decode hot loop)."""
+    *lead, Kh, N = packed.shape
+    K = Kh * 2
+    if group is None:
+        group = infer_group(packed, scale)
+    w = unpack_int4(packed, group).reshape(*lead, K // group, group, N)
+    w = w.astype(jnp.float32) * scale
+    return w.reshape(*lead, K, N).astype(dtype)
+
+
+def _w4_kernel(x_ref, p_ref, s_ref, o_ref, acc_scr, *, group: int):
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    bk2, bn = p_ref.shape  # [bk/2, bn] packed bytes; bk == group
+    p = p_ref[:].astype(jnp.int32)
+    lo = (p & 0xF) - 8
+    hi = (p >> 4) - 8
+    w = jnp.concatenate([lo, hi], axis=0)  # [bk, bn] int32, split-half order
+    # scale on the weight tile (group-wise scales can't post-scale the acc);
+    # the bf16 copy lives only in VMEM
+    w = (w.astype(jnp.float32) * s_ref[0]).astype(x_ref.dtype)
+    acc_scr[:] += jax.lax.dot_general(
+        x_ref[:],
+        w,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
+
+
+def _pick_bn(N: int, candidates=(512, 256, 128)) -> int:
+    for c in candidates:
+        if N % c == 0:
+            return c
+    return 0
+
+
+M_BLOCK = 256  # as quantized_matmul.M_BLOCK: bounds VMEM for prefill-sized M
+
+
+@functools.partial(jax.jit, static_argnames=("group", "interpret"))
+def _w4mm_2d(x, packed, scale, group=GROUP, interpret=False):
+    M, K = x.shape
+    N = packed.shape[1]
+    bm = M if M <= M_BLOCK else M_BLOCK
+    bk, bn = group, _pick_bn(N)
+    grid = (M // bm, N // bn, K // bk)
+    kernel = functools.partial(_w4_kernel, group=group)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, i, j: (m, j)),
+            pl.BlockSpec((bk // 2, bn), lambda m, i, j: (j, i)),
+            pl.BlockSpec((1, 1, bn), lambda m, i, j: (j, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, i, j: (m, i)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, packed, scale)
+
+
+def int4_matmul(
+    x: jnp.ndarray,  # [..., K] activations (bf16/f32)
+    packed: jnp.ndarray,  # [K/2, N] packed nibbles
+    scale: jnp.ndarray,  # [K/group, 1, N] f32
+    *,
+    group: int = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ dequant(packed) without the dequantized weight touching HBM."""
+    if group is None:
+        group = infer_group(packed, scale)
+    K = packed.shape[0] * 2
+    N = packed.shape[1]
+    lead = x.shape[:-1]
+    M = 1
+    for d in lead:
+        M *= d
+    x2 = x.reshape(M, K)
+    pad = (-M) % (8 if M <= M_BLOCK else M_BLOCK)
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = _w4mm_2d(x2, packed, scale, group=group, interpret=interpret)
+    if pad:
+        out = out[:M]
+    return out.reshape(*lead, N)
+
+
+def int4_matmul_reference(x, packed, scale, group: int = None):
+    """Dequantize-then-matmul ground truth (CPU fallback).
+
+    Dequantizes to bf16 exactly like the kernel's VMEM tile so parity
+    tests compare like-for-like rounding.
+    """
+    if group is None:
+        group = infer_group(packed, scale)
+    w = dequantize_int4(packed, scale, group, dtype=jnp.bfloat16)
+    y = jax.lax.dot_general(
+        x.astype(jnp.bfloat16),
+        w,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return y.astype(x.dtype)

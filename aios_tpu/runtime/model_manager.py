@@ -26,7 +26,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -104,7 +104,7 @@ class ModelManager:
         num_slots: int = 8,
         sharding_plan=None,
         warm_compile: bool = True,
-        quantize: Optional[bool] = None,
+        quantize: Union[bool, str, None] = None,  # None=auto, bool, "int8"/"int4"
     ) -> None:
         self.models: Dict[str, ManagedModel] = {}
         self.num_slots = num_slots
@@ -120,8 +120,18 @@ class ModelManager:
             if env in ("0", "false", "off"):
                 quantize = False
             elif env in ("1", "true", "int8"):
-                quantize = True
+                quantize = "int8"
+            elif env == "int4":
+                # group-wise packed-nibble int4 (ops/int4_matmul.py): half
+                # the int8 weight bytes, Q4-class precision like the
+                # reference's GGUF serving format
+                quantize = "int4"
             else:
+                if env:
+                    log.warning(
+                        "unrecognized AIOS_TPU_QUANTIZE=%r (expected 0/1/"
+                        "int8/int4); using the auto default", env,
+                    )
                 try:
                     import jax
 
@@ -132,8 +142,10 @@ class ModelManager:
                 # the conservative bf16 default until measured on a real
                 # mesh — but an EXPLICIT AIOS_TPU_QUANTIZE=1 is honored
                 # either way (the engine shards the unfused int8 layout)
-                quantize = sharding_plan is None and on_tpu
-        self.quantize = bool(quantize)
+                quantize = "int8" if (sharding_plan is None and on_tpu) else False
+        elif quantize is True:
+            quantize = "int8"
+        self.quantize = quantize or False
         # AIOS_TPU_KV_CACHE=int8 halves KV-cache footprint/traffic (the
         # long-context + co-residency lever); default bf16. Composes with a
         # sharding plan: cache + scales shard by the plan's cache rules and

@@ -85,7 +85,8 @@ def probe_backend(attempts: int = 4) -> bool:
 
 
 def bench_decode(name, cfg, *, num_slots, active_slots, max_context,
-                 prompt_len, chunk, measure_chunks, quant_kv=False):
+                 prompt_len, chunk, measure_chunks, quant_kv=False,
+                 weight_mode="int8"):
     """One decode-throughput config; returns the result dict."""
     import jax
     import jax.numpy as jnp
@@ -94,7 +95,9 @@ def bench_decode(name, cfg, *, num_slots, active_slots, max_context,
     from aios_tpu.engine.engine import TPUEngine
 
     t0 = time.time()
-    params = model_mod.init_quantized_params(cfg, jax.random.PRNGKey(0))
+    params = model_mod.init_quantized_params(
+        cfg, jax.random.PRNGKey(0), mode=weight_mode
+    )
     weight_bytes = model_mod.serving_weight_bytes(params)
     engine = TPUEngine(
         cfg,
@@ -159,6 +162,7 @@ def bench_decode(name, cfg, *, num_slots, active_slots, max_context,
         "hbm_util_v5e": round(hbm_gbps / V5E_HBM_GBPS, 3),
         "batch": active_slots,
         "kv_cache": "int8" if quant_kv else "bf16",
+        "weights": weight_mode,
     }
 
 
@@ -695,6 +699,21 @@ def main() -> int:
             name="mistral-7b batched decode throughput (8 slots, int8 serving)",
             cfg=MISTRAL_7B, num_slots=8, active_slots=8, max_context=1024,
             prompt_len=64, chunk=128, measure_chunks=2, quant_kv=False,
+        ),
+        # int4 serving (ops/int4_matmul.py): half the int8 weight bytes —
+        # the decode path is weight-bandwidth-bound, so this is the
+        # headline single-chip throughput lever for the 7B tier
+        dict(
+            name="mistral-7b batched decode throughput (8 slots, int4 serving)",
+            cfg=MISTRAL_7B, num_slots=8, active_slots=8, max_context=1024,
+            prompt_len=64, chunk=128, measure_chunks=2, quant_kv=False,
+            weight_mode="int4",
+        ),
+        dict(
+            name="mistral-7b single-request decode (int4 serving)",
+            cfg=MISTRAL_7B, num_slots=1, active_slots=1, max_context=1024,
+            prompt_len=64, chunk=64, measure_chunks=3, quant_kv=False,
+            weight_mode="int4",
         ),
     ]
     if args.skip_mistral:

@@ -339,3 +339,153 @@ def test_decode_step_int8_ragged_wiring(monkeypatch):
     assert called.get("hit")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# int4 serving weights (ops/int4_matmul.py)
+# ---------------------------------------------------------------------------
+
+
+def test_int4_pack_unpack_roundtrip():
+    import importlib
+    i4 = importlib.import_module("aios_tpu.ops.int4_matmul")
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.integers(-8, 8, size=(256, 128), dtype=np.int64),
+                    jnp.float32)
+    p, s = i4.quantize_int4(q * 1.0, group=128)  # values already int => exact
+    w = i4.unpack_int4(p, group=128).astype(jnp.float32)
+    scaled = np.asarray(i4.dequantize_int4(p, s, dtype=jnp.float32))
+    # unpack must invert pack ordering: dequant(q) == q * group-scale, and
+    # since the group absmax is an integer multiple of every value / 7...
+    # the robust invariant: quantize(dequantize(p)) is a fixed point
+    p2, s2 = i4.quantize_int4(jnp.asarray(scaled), group=128)
+    np.testing.assert_array_equal(np.asarray(p), np.asarray(p2))
+    assert w.shape == (256, 128)
+
+
+@pytest.mark.parametrize("M,K,N,group", [
+    (8, 256, 128, 128),
+    (3, 512, 384, 128),   # M padding
+    (16, 256, 256, None), # auto group
+])
+def test_int4_matmul_parity(M, K, N, group):
+    import importlib
+    i4 = importlib.import_module("aios_tpu.ops.int4_matmul")
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(11))
+    w = _rand(k1, (K, N), scale=0.05)
+    x = _rand(k2, (M, K), dtype=jnp.bfloat16)
+    p, s = i4.quantize_int4(w, group=group)
+    ref = i4.int4_matmul_reference(x, p, s)
+    out = i4.int4_matmul(x, p, s, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_int4_matmul_close_to_float():
+    import importlib
+    i4 = importlib.import_module("aios_tpu.ops.int4_matmul")
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(12))
+    w = _rand(k1, (512, 256), scale=0.05)
+    x = _rand(k2, (8, 512), dtype=jnp.bfloat16)
+    p, s = i4.quantize_int4(w)
+    exact = (x.astype(jnp.float32) @ w.astype(jnp.float32))
+    approx = i4.int4_matmul(x, p, s, interpret=True).astype(jnp.float32)
+    denom = float(jnp.linalg.norm(exact)) + 1e-9
+    rel = float(jnp.linalg.norm(approx - exact)) / denom
+    # plain RTN group-wise int4 on gaussian weights: RMS error is
+    # step/sqrt(12) with step ~= absmax(128)/7 ~= 0.4 sigma -> ~11-12%
+    assert rel < 0.15, rel
+
+
+def test_int4_group_inference_and_small_groups():
+    import importlib
+    i4 = importlib.import_module("aios_tpu.ops.int4_matmul")
+
+    # K=64 falls back to group 64; kernel is not eligible, reference works
+    assert i4.pick_group(64) == 64
+    assert not i4.kernel_supported(64, 128, 64)
+    w = _rand(jax.random.PRNGKey(13), (64, 128), scale=0.1)
+    p, s = i4.quantize_int4(w)
+    assert i4.infer_group(p, s) == 64
+    x = _rand(jax.random.PRNGKey(14), (4, 64), dtype=jnp.bfloat16)
+    out = i4.int4_matmul_reference(x, p, s)
+    exact = x.astype(jnp.float32) @ w.astype(jnp.float32)
+    denom = float(jnp.linalg.norm(exact)) + 1e-9
+    assert float(jnp.linalg.norm(out.astype(jnp.float32) - exact)) / denom < 0.15
+
+
+def test_quantize_params_int4_mode():
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(15), dtype=jnp.float32)
+    qp = M.quantize_params(params, mode="int4")
+    # fused w_qkv [E=64, 96]: K=64 -> group 64 storage works
+    assert "q4" in qp["layers"]["w_qkv"]
+    assert qp["layers"]["w_qkv"]["q4"].dtype == jnp.uint8
+    # logits head quantizes too
+    assert "q4" in qp["lm_head"] or "q" in qp["lm_head"]
+    # forward stays close to float
+    tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    lf = M.forward_full(params, TINY_TEST, tokens)
+    lq = M.forward_full(qp, TINY_TEST, tokens)
+    denom = float(jnp.linalg.norm(lf)) + 1e-9
+    rel = float(jnp.linalg.norm(lq - lf)) / denom
+    # group-64 int4 on a 2-layer random model: coarse but bounded
+    assert rel < 0.3, rel
+
+
+def test_int4_engine_decode_matches_dense_on_fixed_point():
+    """Greedy decode with int4 serving == dense decode when the weights are
+    already exact int4 fixed points (quantize->dequantize round-trip), so
+    the comparison isolates the serving path from quantization error."""
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    import importlib
+    i4 = importlib.import_module("aios_tpu.ops.int4_matmul")
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(16), dtype=jnp.float32)
+
+    def roundtrip(w):
+        if w.ndim >= 2 and i4.supports_int4(w.shape[-2], w.shape[-1]):
+            p, s = i4.quantize_int4(w)
+            return i4.dequantize_int4(p, s, dtype=jnp.float32)
+        return w
+
+    fixed = dict(params)
+    fixed["layers"] = {k: roundtrip(v) for k, v in params["layers"].items()}
+    # tied lm_head: materialize + round-trip it so the head matmul is a
+    # fixed point for both engines too
+    fixed["lm_head"] = roundtrip(params["embed"].T)
+    eng_f = TPUEngine(TINY_TEST, fixed, num_slots=2, max_context=64,
+                      cache_dtype=jnp.float32)
+    eng_q = TPUEngine(TINY_TEST, fixed, num_slots=2, max_context=64,
+                      cache_dtype=jnp.float32, quantize="int4")
+    assert eng_q.quantized and eng_q.quant_mode == "int4"
+    prompt = [1, 5, 9, 2]
+    out_f = eng_f.generate(prompt, max_new_tokens=8, temperature=0.0)
+    out_q = eng_q.generate(prompt, max_new_tokens=8, temperature=0.0)
+    # bf16 rounding differs between the dense-f32 and int4-dequant paths,
+    # so late tokens may drift on a random tiny model; the early steps of
+    # the greedy path must agree exactly
+    assert out_f[:3] == out_q[:3], (out_f, out_q)
+
+
+def test_int4_downgrades_to_int8_under_sharding_plan():
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(17), dtype=jnp.float32)
+    plan = ShardingPlan(build_mesh(tp=2, n_devices=2))
+    eng = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                    shardings=plan, quantize="int4")
+    assert eng.quant_mode == "int8"
+    assert "q" in eng.params["layers"]["wq"]
