@@ -25,6 +25,7 @@ price of a fixed-shape graph and it is what keeps XLA fast.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -38,6 +39,26 @@ from .config import ModelConfig
 log = logging.getLogger("aios.engine")
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def _cpu_device():
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:  # noqa: BLE001 — no CPU backend registered
+        return None
+
+
+def _on_accelerator(params) -> bool:
+    """True if ANY param leaf already lives on a non-CPU jax device (a
+    mixed tree must not round-trip device weights through the host)."""
+    for leaf in jax.tree.leaves(params):
+        if isinstance(leaf, jax.Array):
+            try:
+                if leaf.devices().pop().platform != "cpu":
+                    return True
+            except Exception:  # noqa: BLE001
+                continue
+    return False
 
 # Device-resident decode state, threaded through the jitted cores as one
 # donated pytree: {k, v, lengths, last_tokens, temps, top_ps, key}
@@ -100,17 +121,24 @@ class TPUEngine:
         # EXCEPT decode attention, which is head/slot-local and runs the
         # ragged kernel per device under shard_map (see _attn_impl below).
         self._kernels: Optional[bool] = False if shardings is not None else None
-        # MoE decode: when every slot's picks together touch fewer experts
-        # than exist, the gathered path streams only the routed experts'
-        # weights (moe.moe_ffn_gather — up to X/(slots*k) less FFN HBM
-        # traffic). Single-device only: under EP the expert axis is sharded
-        # and the dense path's psum is the right collective. Decode/verify
-        # dispatches only — prefill token counts saturate the experts.
+        # MoE decode: the gathered path streams only the routed experts'
+        # weights (moe.moe_ffn_gather) when every slot's picks together
+        # touch fewer experts than exist. Measured on v5e (2.3B geometry,
+        # 32 experts top-4, single request): gather 126.5 tok/s vs dense
+        # 216.4 — the expert-weight gather costs more than the skipped
+        # streaming saves at small expert sizes, so DENSE is the default
+        # and AIOS_TPU_MOE_GATHER=1 opts in (bigger experts / higher
+        # X/(slots*k) ratios may still favor it). Single-device only:
+        # under EP the expert axis is sharded and the dense path's psum is
+        # the right collective. Decode/verify dispatches only — prefill
+        # token counts saturate the experts.
         self._moe_impl: Optional[str] = None
         if (
             cfg.moe
             and shardings is None
             and num_slots * cfg.num_experts_per_tok < cfg.num_experts
+            and os.environ.get("AIOS_TPU_MOE_GATHER", "").lower()
+            in ("1", "true", "on")
         ):
             self._moe_impl = "gather"
 
@@ -126,11 +154,34 @@ class TPUEngine:
             else:
                 self.params = shardings.put_params(params)
         else:
-            self.params = jax.tree.map(jnp.asarray, params)
-            if quantize:
-                self.params = model.quantize_params(
-                    self.params, mode=quantize
-                )
+            if quantize and not _on_accelerator(params):
+                # Host-resident params (GGUF load, checkpoints staged on
+                # CPU): quantize on the host CPU backend FIRST, then ship
+                # only the quantized leaves. Transferring dense bf16 and
+                # quantizing on-device would stage dense + quantized HBM
+                # at once — an OOM for the 7B tier on a 16 GB chip.
+                cpu = _cpu_device()
+                if cpu is not None:
+                    with jax.default_device(cpu):
+                        qp = model.quantize_params(
+                            jax.tree.map(jnp.asarray, params), mode=quantize
+                        )
+                    # explicit device_put: jnp.asarray on a CPU-committed
+                    # jax.Array is an identity and would leave the weights
+                    # host-resident (PCIe-speed decode)
+                    self.params = jax.tree.map(
+                        lambda a: jax.device_put(a), qp
+                    )
+                else:
+                    self.params = model.quantize_params(
+                        jax.tree.map(jnp.asarray, params), mode=quantize
+                    )
+            else:
+                self.params = jax.tree.map(jnp.asarray, params)
+                if quantize:
+                    self.params = model.quantize_params(
+                        self.params, mode=quantize
+                    )
 
         # Context-sharded KV: the cache's C axis splits over the mesh's sp
         # axis, so one slot's KV can exceed a single chip's HBM — XLA

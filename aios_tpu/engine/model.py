@@ -152,9 +152,24 @@ def quantize_params(
         to_quant = tuple((k, src[k]) for k in QUANT_KEYS if k in src)
     def quant_leaf(key, w):
         if mode == "int4" and not key.startswith("we_"):
-            from ..ops.int4_matmul import quantize_int4, supports_int4
+            from ..ops.int4_matmul import (
+                kernel_supported,
+                pick_group,
+                quantize_int4,
+                supports_int4,
+            )
 
-            if supports_int4(w.shape[-2], w.shape[-1]):
+            K, N = w.shape[-2], w.shape[-1]
+            # On TPU a q4 leaf the kernel can't serve would dequantize to
+            # bf16 in HBM every step — strictly worse than int8 — so
+            # kernel-ineligible dims fall back to int8 there. Off-TPU every
+            # quantized leaf dequantizes inline anyway, so storage
+            # eligibility is enough (keeps tiny test geometries on int4).
+            eligible = supports_int4(K, N) and (
+                kernel_supported(K, N, pick_group(K))
+                or not ops.use_pallas()
+            )
+            if eligible:
                 p, s = quantize_int4(w)
                 return {"q4": p, "s4": s}
         q, s = ops.quantize_int8(w, axis=-2)
@@ -505,10 +520,15 @@ def _use_ragged_kernel(
     always preferable to the gather fallback, so it gates only on
     _use_kernels + the env flag."""
     kv_row = cfg.num_kv_heads * cfg.head_dim
+    # the int8 kernel variants DMA-slice the cache axis on lanes, so they
+    # need 128-aligned kv blocks (C % 128 == 0 makes pick_block_kv choose
+    # >= 128); ineligible geometries stay on the XLA dequant path instead
+    # of tripping the kernels' alignment guard
+    int8_geometry_ok = C % 128 == 0
     return (
         _use_kernels(kernels)
         and (C >= _ragged_min_c() or C * kv_row >= 1 << 20)
-        and (not quant_cache or quant_kernel_ok)
+        and (not quant_cache or (quant_kernel_ok and int8_geometry_ok))
     )
 
 
@@ -1316,10 +1336,17 @@ def init_quantized_params(
 
     def qleaf(shape, force_int8: bool = False):
         if mode == "int4" and not force_int8:
-            from ..ops.int4_matmul import pick_group, supports_int4
+            from ..ops.int4_matmul import (
+                kernel_supported,
+                pick_group,
+                supports_int4,
+            )
 
             K, N = shape[-2], shape[-1]
-            if supports_int4(K, N):
+            # same eligibility rule as quantize_params.quant_leaf
+            if supports_int4(K, N) and (
+                kernel_supported(K, N, pick_group(K)) or not ops.use_pallas()
+            ):
                 g = pick_group(K)
                 block = jax.random.randint(
                     next(keys), (K // 2, N), 0, 256, jnp.int32

@@ -42,8 +42,11 @@ def _decode_kernel(
     q_ref,  # VMEM [1, H, D]
     k_hbm,  # ANY  [B, C, KH*D]  (bf16, or int8 when quantized)
     v_hbm,  # ANY  [B, C, KH*D]
-    *rest,  # quantized: ks_hbm [B, C, KH] f32, vs_hbm [B, C, KH] f32, o_ref
+    *rest,  # quantized: ks_hbm [B, KH, C] f32, vs_hbm [B, KH, C] f32, o_ref
     #         else: o_ref
+    # (scales arrive head-major so the lane dim is the 128-aligned cache
+    #  axis — a [.., C, KH] layout would DMA-slice KH lanes, which Mosaic
+    #  rejects for KH < 128)
     num_kv_heads: int,
     head_dim: int,
     block_kv: int,
@@ -81,19 +84,27 @@ def _decode_kernel(
                 sems.at[slot, sem_idx],
             )
 
+        def dma_scales(buf_hbm, scr, slot, blk, sem_idx):
+            # head-major scales: slice the lane (cache) axis, heads full
+            return pltpu.make_async_copy(
+                buf_hbm.at[b, :, pl.ds(blk * bk, bk)],
+                scr.at[slot],
+                sems.at[slot, sem_idx],
+            )
+
         def start_all(slot, blk):
             dma(k_hbm, k_buf, slot, blk, 0).start()
             dma(v_hbm, v_buf, slot, blk, 1).start()
             if quantized:
-                dma(ks_hbm, ks_buf, slot, blk, 2).start()
-                dma(vs_hbm, vs_buf, slot, blk, 3).start()
+                dma_scales(ks_hbm, ks_buf, slot, blk, 2).start()
+                dma_scales(vs_hbm, vs_buf, slot, blk, 3).start()
 
         def wait_all(slot, blk):
             dma(k_hbm, k_buf, slot, blk, 0).wait()
             dma(v_hbm, v_buf, slot, blk, 1).wait()
             if quantized:
-                dma(ks_hbm, ks_buf, slot, blk, 2).wait()
-                dma(vs_hbm, vs_buf, slot, blk, 3).wait()
+                dma_scales(ks_hbm, ks_buf, slot, blk, 2).wait()
+                dma_scales(vs_hbm, vs_buf, slot, blk, 3).wait()
 
         start_all(0, start_blk)
 
@@ -108,7 +119,7 @@ def _decode_kernel(
             wait_all(slot, i)
             kb = k_buf[slot]  # [bk, KH*D]
             vb = v_buf[slot]
-            ksb = ks_buf[slot] if quantized else None  # [bk, KH] f32
+            ksb = ks_buf[slot] if quantized else None  # [KH, bk] f32
             vsb = vs_buf[slot] if quantized else None
 
             cols = i * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
@@ -130,7 +141,7 @@ def _decode_kernel(
                     preferred_element_type=jnp.float32,
                 )  # [G, bk] — int8 magnitudes are exact in f32
                 if quantized:
-                    sh = sh * ksb[:, h][None, :]
+                    sh = sh * ksb[h][None, :]
                 parts.append(sh)
             s = jnp.concatenate(parts, axis=0)  # [H, bk]
             s = jnp.where(valid, s, NEG_INF)
@@ -147,7 +158,7 @@ def _decode_kernel(
             for h in range(KH):
                 ph = pv[h * G : (h + 1) * G, :]  # [G, bk]
                 if quantized:
-                    ph = ph * vsb[:, h][None, :]
+                    ph = ph * vsb[h][None, :]
                 vh = vb[:, h * D : (h + 1) * D]  # [bk, D]
                 if quantized:
                     vh = vh.astype(jnp.float32)
@@ -177,8 +188,8 @@ def _decode_kernel(
             k_buf=pltpu.VMEM((2, bk, KH * D), jnp.int8),
             v_buf=pltpu.VMEM((2, bk, KH * D), jnp.int8),
             sems=pltpu.SemaphoreType.DMA((2, 4)),
-            ks_buf=pltpu.VMEM((2, bk, KH), jnp.float32),
-            vs_buf=pltpu.VMEM((2, bk, KH), jnp.float32),
+            ks_buf=pltpu.VMEM((2, KH, bk), jnp.float32),
+            vs_buf=pltpu.VMEM((2, KH, bk), jnp.float32),
         )
     else:
         pl.run_scoped(
@@ -208,6 +219,14 @@ def _ragged_call(q, k_cache, v_cache, lengths, scales, *, window, block_kv,
             f"block_kv {bk} must evenly divide cache length {C}"
         )
     quantized = scales is not None
+    if quantized and bk % 128 and not interpret:
+        # Mosaic tiles lanes at 128: a smaller block would DMA-slice an
+        # unaligned lane extent of the caches (interpret mode has no
+        # such constraint and the tests use tiny blocks there)
+        raise ValueError(
+            f"int8 ragged kernel needs 128-aligned kv blocks, got {bk} "
+            f"(cache length {C})"
+        )
     kernel = functools.partial(
         _decode_kernel,
         num_kv_heads=KH,
@@ -227,7 +246,11 @@ def _ragged_call(q, k_cache, v_cache, lengths, scales, *, window, block_kv,
         v_cache.reshape(B, C, KH * D),
     ]
     if quantized:
-        args.extend(scales)
+        # engine stores scales [B, C, KH]; the kernel wants them head-major
+        # [B, KH, C] so its DMA slices the 128-aligned cache axis on lanes.
+        # The transpose costs ~3% of one int8 cache sweep (f32 scales are
+        # 4/D of the cache bytes) — second-order next to the ragged win.
+        args.extend(s.transpose(0, 2, 1) for s in scales)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),

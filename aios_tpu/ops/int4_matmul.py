@@ -196,6 +196,11 @@ def int4_matmul(
     """x @ dequant(packed) without the dequantized weight touching HBM."""
     if group is None:
         group = infer_group(packed, scale)
+    if not kernel_supported(packed.shape[0] * 2, packed.shape[1], group):
+        raise ValueError(
+            f"int4 kernel needs 128-aligned group/N (got group={group}, "
+            f"shape {packed.shape}); use int4_matmul_reference"
+        )
     K = packed.shape[0] * 2
     N = packed.shape[1]
     lead = x.shape[:-1]

@@ -36,7 +36,8 @@ def _paged_decode_kernel(
     q_ref,  # VMEM [1, H, D]
     k_pool,  # ANY  [N, P, KH*D]  (bf16, or int8 when quantized)
     v_pool,  # ANY  [N, P, KH*D]
-    *rest,  # quantized: ks_pool [N, P, KH] f32, vs_pool, o_ref; else o_ref
+    *rest,  # quantized: ks_pool [N, KH, P] f32 (head-major — the lane dim
+    #         must be the 128-aligned page axis), vs_pool, o_ref; else o_ref
     num_kv_heads: int,
     head_dim: int,
     page_size: int,
@@ -102,7 +103,7 @@ def _paged_decode_kernel(
             wait_all(slot, i)
             kb = k_buf[slot]  # [P, KH*D]
             vb = v_buf[slot]
-            ksb = ks_buf[slot] if quantized else None  # [P, KH] f32
+            ksb = ks_buf[slot] if quantized else None  # [KH, P] f32
             vsb = vs_buf[slot] if quantized else None
 
             cols = i * P + jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
@@ -123,7 +124,7 @@ def _paged_decode_kernel(
                     preferred_element_type=jnp.float32,
                 )
                 if quantized:
-                    sh = sh * ksb[:, h][None, :]
+                    sh = sh * ksb[h][None, :]
                 parts.append(sh)
             s = jnp.concatenate(parts, axis=0)  # [H, P]
             s = jnp.where(valid, s, NEG_INF)
@@ -140,7 +141,7 @@ def _paged_decode_kernel(
             for h in range(KH):
                 ph = pv[h * G : (h + 1) * G, :]  # [G, P]
                 if quantized:
-                    ph = ph * vsb[:, h][None, :]
+                    ph = ph * vsb[h][None, :]
                 vh = vb[:, h * D : (h + 1) * D]  # [P, D]
                 if quantized:
                     vh = vh.astype(jnp.float32)
@@ -170,8 +171,8 @@ def _paged_decode_kernel(
             k_buf=pltpu.VMEM((2, P, KH * D), jnp.int8),
             v_buf=pltpu.VMEM((2, P, KH * D), jnp.int8),
             sems=pltpu.SemaphoreType.DMA((2, 4)),
-            ks_buf=pltpu.VMEM((2, P, KH), jnp.float32),
-            vs_buf=pltpu.VMEM((2, P, KH), jnp.float32),
+            ks_buf=pltpu.VMEM((2, KH, P), jnp.float32),
+            vs_buf=pltpu.VMEM((2, KH, P), jnp.float32),
         )
     else:
         pl.run_scoped(
@@ -208,7 +209,9 @@ def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
         v_pool.reshape(N, P, KH * D),
     ]
     if quantized:
-        args.extend(scales)
+        # [N, P, KH] -> head-major [N, KH, P]: the whole-page DMA then has
+        # the 128-row page axis on lanes (see decode_attention.py)
+        args.extend(s.transpose(0, 2, 1) for s in scales)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),

@@ -35,7 +35,8 @@ def _mq_kernel(
     q_ref,  # VMEM [1, T, H, D]
     k_hbm,  # ANY  [B, C, KH*D]  (bf16, or int8 when quantized)
     v_hbm,  # ANY  [B, C, KH*D]
-    *rest,  # quantized: ks_hbm [B, C, KH] f32, vs_hbm, o_ref; else o_ref
+    *rest,  # quantized: ks_hbm [B, KH, C] f32 (head-major — the lane dim
+    #         must be the 128-aligned cache axis), vs_hbm, o_ref; else o_ref
     num_kv_heads: int,
     head_dim: int,
     block_kv: int,
@@ -78,19 +79,27 @@ def _mq_kernel(
                 sems.at[slot, sem_idx],
             )
 
+        def dma_scales(buf_hbm, scr, slot, blk, sem_idx):
+            # head-major scales: slice the lane (cache) axis, heads full
+            return pltpu.make_async_copy(
+                buf_hbm.at[b, :, pl.ds(blk * bk, bk)],
+                scr.at[slot],
+                sems.at[slot, sem_idx],
+            )
+
         def start_all(slot, blk):
             dma(k_hbm, k_buf, slot, blk, 0).start()
             dma(v_hbm, v_buf, slot, blk, 1).start()
             if quantized:
-                dma(ks_hbm, ks_buf, slot, blk, 2).start()
-                dma(vs_hbm, vs_buf, slot, blk, 3).start()
+                dma_scales(ks_hbm, ks_buf, slot, blk, 2).start()
+                dma_scales(vs_hbm, vs_buf, slot, blk, 3).start()
 
         def wait_all(slot, blk):
             dma(k_hbm, k_buf, slot, blk, 0).wait()
             dma(v_hbm, v_buf, slot, blk, 1).wait()
             if quantized:
-                dma(ks_hbm, ks_buf, slot, blk, 2).wait()
-                dma(vs_hbm, vs_buf, slot, blk, 3).wait()
+                dma_scales(ks_hbm, ks_buf, slot, blk, 2).wait()
+                dma_scales(vs_hbm, vs_buf, slot, blk, 3).wait()
 
         start_all(0, start_blk)
 
@@ -105,7 +114,7 @@ def _mq_kernel(
             wait_all(slot, i)
             kb = k_buf[slot]  # [bk, KH*D]
             vb = v_buf[slot]
-            ksb = ks_buf[slot] if quantized else None  # [bk, KH] f32
+            ksb = ks_buf[slot] if quantized else None  # [KH, bk] f32
             vsb = vs_buf[slot] if quantized else None
 
             cols = i * bk + jax.lax.broadcasted_iota(jnp.int32, (T, bk), 1)
@@ -126,7 +135,7 @@ def _mq_kernel(
                     preferred_element_type=jnp.float32,
                 )  # [T*G, bk]
                 if quantized:
-                    s = s * ksb[:, h][None, :]
+                    s = s * ksb[h][None, :]
                 parts.append(jnp.where(validg, s, NEG_INF))
             s_all = jnp.concatenate(parts, axis=0)  # [KH*T*G, bk]
 
@@ -143,7 +152,7 @@ def _mq_kernel(
             for h in range(KH):
                 ph = p[h * T * G : (h + 1) * T * G, :]
                 if quantized:
-                    ph = ph * vsb[:, h][None, :]
+                    ph = ph * vsb[h][None, :]
                 else:
                     ph = ph.astype(vb.dtype)
                 vh = vb[:, h * D : (h + 1) * D]
@@ -175,8 +184,8 @@ def _mq_kernel(
             k_buf=pltpu.VMEM((2, bk, KH * D), jnp.int8),
             v_buf=pltpu.VMEM((2, bk, KH * D), jnp.int8),
             sems=pltpu.SemaphoreType.DMA((2, 4)),
-            ks_buf=pltpu.VMEM((2, bk, KH), jnp.float32),
-            vs_buf=pltpu.VMEM((2, bk, KH), jnp.float32),
+            ks_buf=pltpu.VMEM((2, KH, bk), jnp.float32),
+            vs_buf=pltpu.VMEM((2, KH, bk), jnp.float32),
         )
     else:
         pl.run_scoped(
@@ -198,6 +207,11 @@ def _mq_call(q, k_cache, v_cache, lengths, strides, scales, *, window,
     if C % bk:
         raise ValueError(f"block_kv {bk} must evenly divide cache length {C}")
     quantized = scales is not None
+    if quantized and bk % 128 and not interpret:
+        raise ValueError(
+            f"int8 mq kernel needs 128-aligned kv blocks, got {bk} "
+            f"(cache length {C})"
+        )
     kernel = functools.partial(
         _mq_kernel,
         num_kv_heads=KH,
@@ -218,7 +232,8 @@ def _mq_call(q, k_cache, v_cache, lengths, strides, scales, *, window,
         v_cache.reshape(B, C, KH * D),
     ]
     if quantized:
-        args.extend(scales)
+        # [B, C, KH] -> head-major [B, KH, C] (see decode_attention.py)
+        args.extend(s.transpose(0, 2, 1) for s in scales)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),

@@ -434,10 +434,11 @@ def bench_moe_gather():
     """Gathered-expert MoE decode A/B on the real chip: a ~2.3B-param
     MoE geometry (32 experts, top-4 — qwen3-moe-style, scaled to fit one
     chip's HBM comfortably) decoded single-request with the gathered path
-    (streams only the routed experts' weights, engine auto-picks it at
-    slots*k < X) vs the dense-all-experts path. The ratio is the point:
-    it demonstrates the HBM-traffic win that makes single-chip MoE serving
-    viable; qwen3-30b-a3b itself needs a multi-chip slice (--virtual-ep)."""
+    (streams only the routed experts' weights; AIOS_TPU_MOE_GATHER opt-in)
+    vs the dense-all-experts path. Measured r3: gather 126.5 vs dense
+    216.4 tok/s — the expert gather costs more than the skipped streaming
+    saves at this geometry, which is why dense is the engine default;
+    qwen3-30b-a3b itself needs a multi-chip slice (--virtual-ep)."""
     import jax
     import jax.numpy as jnp
 
@@ -466,9 +467,9 @@ def bench_moe_gather():
     for impl in ("gather", "dense"):
         eng = TPUEngine(cfg, params, num_slots=1, max_context=1024,
                         cache_dtype=jnp.bfloat16)
-        assert eng._moe_impl == "gather"  # 1*4 < 32
-        if impl == "dense":
-            eng._moe_impl = None
+        # force each arm explicitly (the engine default is dense; gather
+        # is the AIOS_TPU_MOE_GATHER opt-in, sparse-eligible here: 1*4<32)
+        eng._moe_impl = "gather" if impl == "gather" else None
         eng.prefill(0, list(range(1, 65)), temperature=0.7, top_p=0.95)
         eng.step(chunk)  # compile
         eng.step(chunk)  # warm

@@ -221,3 +221,26 @@ def test_top_p_excludes_tail_statistically():
     )
     # nucleus at 0.6 keeps tokens 0 and 1 only
     assert set(np.asarray(toks).tolist()) <= {0, 1}
+
+
+def test_host_params_quantize_before_transfer():
+    """GGUF-style host (numpy) params with quantized serving: the engine
+    quantizes on the host CPU backend and ships only quantized leaves, so
+    dense weights never stage on the accelerator (the 7B-tier OOM guard).
+    Tokens must match quantizing from device-resident params."""
+    import numpy as np
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(21), dtype=jnp.float32)
+    host_params = jax.tree.map(lambda a: np.asarray(a), params)
+    eng_host = TPUEngine(TINY_TEST, host_params, num_slots=2, max_context=64,
+                         cache_dtype=jnp.float32, quantize="int8")
+    eng_dev = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                        cache_dtype=jnp.float32, quantize="int8")
+    assert "q" in eng_host.params["layers"]["w_qkv"]
+    out_h = eng_host.generate([1, 5, 9, 2], max_new_tokens=8, temperature=0.0)
+    out_d = eng_dev.generate([1, 5, 9, 2], max_new_tokens=8, temperature=0.0)
+    assert out_h == out_d

@@ -420,6 +420,40 @@ def test_fixture_decodes_coherently_through_runtime(tmp_path):
     assert got == want
 
 
+def test_fixture_serves_int4_through_runtime(tmp_path, monkeypatch):
+    """The same llama.cpp-layout fixture served with int4 weights
+    (AIOS_TPU_QUANTIZE=int4): load succeeds, the engine holds packed-nibble
+    leaves, and batched greedy decode matches the full forward on the SAME
+    quantized params — the real-GGUF -> int4 serving contract."""
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    rng = np.random.default_rng(12)
+    path = tmp_path / "spec-fixture-int4.gguf"
+    _write_tiny_llama_gguf(path, rng)
+    manager = ModelManager(num_slots=2, warm_compile=False, quantize="int4")
+    managed = manager.load_model("fixture4", str(path), context_length=64)
+    assert managed.state == "ready"
+    m = manager.models["fixture4"]
+    assert m.engine.quant_mode == "int4"
+    assert "q4" in m.engine.params["layers"]["w_qkv"]
+
+    ids = m.tokenizer.encode("abc")
+    got = m.engine.generate(ids, max_new_tokens=6, temperature=0.0)
+    toks = list(ids)
+    want = []
+    for _ in range(6):
+        logits = M.forward_full(
+            m.engine.params, m.config, np.asarray([toks], np.int32)
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        want.append(nxt)
+        toks.append(nxt)
+    assert got == want
+
+
 # ---------------------------------------------------------------------------
 # Tokenizer merge-order contract
 # ---------------------------------------------------------------------------

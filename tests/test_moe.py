@@ -198,12 +198,14 @@ def test_gather_matches_dense_quantized():
         np.testing.assert_allclose(gath, dense, atol=1e-6, rtol=1e-6)
 
 
-def test_engine_auto_selects_gather_only_when_sparse():
-    """slots*k < X -> gathered decode (streams only routed experts);
-    otherwise dense. Sharded engines never gather (ep psum instead)."""
+def test_engine_auto_selects_gather_only_when_sparse(monkeypatch):
+    """AIOS_TPU_MOE_GATHER=1 + slots*k < X -> gathered decode (streams only
+    routed experts); otherwise dense (chip-measured default: dense wins at
+    small expert sizes). Sharded engines never gather (ep psum instead)."""
     from aios_tpu.engine.engine import TPUEngine
     from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
 
+    monkeypatch.setenv("AIOS_TPU_MOE_GATHER", "1")
     cfg = TINY_MOE  # X=4, k=2
     params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     e1 = TPUEngine(cfg, params, num_slots=1, max_context=64,
@@ -231,6 +233,7 @@ def test_verify_gather_gating(monkeypatch):
     when S*(K+1)*k reaches the expert count; decode keeps gathering."""
     from aios_tpu.engine.engine import TPUEngine
 
+    monkeypatch.setenv("AIOS_TPU_MOE_GATHER", "1")
     cfg = TINY_MOE  # X=4, k=2
     params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     seen = {}
@@ -272,9 +275,10 @@ def test_env_var_overrides_engine_gather(monkeypatch):
     assert called.get("dense")
 
 
-def test_spec_decode_under_gather():
+def test_spec_decode_under_gather(monkeypatch):
     from aios_tpu.engine.engine import TPUEngine
 
+    monkeypatch.setenv("AIOS_TPU_MOE_GATHER", "1")
     cfg = TINY_MOE
     params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     eng = TPUEngine(cfg, params, num_slots=1, max_context=64,

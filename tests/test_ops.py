@@ -313,8 +313,8 @@ def test_decode_step_int8_ragged_wiring(monkeypatch):
     params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     toks = jnp.asarray([1, 2, 3, 4], jnp.int32)
     lens = jnp.asarray([5, 0, 9, 2], jnp.int32)
-    k, v = M.init_kv_cache(cfg, 4, 32, jnp.int8)
-    scales = M.init_kv_scales(cfg, 4, 32)
+    k, v = M.init_kv_cache(cfg, 4, 128, jnp.int8)
+    scales = M.init_kv_scales(cfg, 4, 128)
 
     ref, _, _, _ = M.decode_step(
         params, cfg, toks, lens, k, v, kernels=False,

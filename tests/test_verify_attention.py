@@ -180,8 +180,8 @@ def test_verify_step_int8_kernel_wiring(monkeypatch):
     params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     toks = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
     lens = jnp.asarray([5, 9], jnp.int32)
-    k, v = M.init_kv_cache(cfg, 2, 32, jnp.int8)
-    scales = M.init_kv_scales(cfg, 2, 32)
+    k, v = M.init_kv_cache(cfg, 2, 128, jnp.int8)
+    scales = M.init_kv_scales(cfg, 2, 128)
 
     ref = M.verify_step(
         params, cfg, toks, lens, k, v, kernels=False, cache_scales=scales,
