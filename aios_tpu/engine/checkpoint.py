@@ -142,7 +142,16 @@ def is_model_checkpoint(path: str) -> bool:
     ) and is_checkpoint_dir(path)
 
 
-def load_model_checkpoint(directory: str):
+def cpu_device():
+    """The host CPU jax device, or None if that backend is unregistered.
+    (Shared with TPUEngine's host-quantize path — keep the probe single.)"""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def load_model_checkpoint(directory: str, host_stage: bool = True):
     """Returns (cfg, params, tokenizer) from a prepared model directory."""
     import json
 
@@ -153,7 +162,18 @@ def load_model_checkpoint(directory: str):
     with open(os.path.join(directory, MODEL_META_NAME)) as fh:
         meta = json.load(fh)
     cfg = ModelConfig(**meta["config"])
-    params = load_params(directory)
+    # host_stage: restore onto the host CPU backend instead of the default
+    # device. Needed when a quantize pass will follow — restoring a big
+    # dense checkpoint straight to the accelerator and THEN quantizing
+    # would hold dense + quantized HBM at once (7B OOM). The engine does
+    # final placement either way (TPUEngine device_puts, host-quantizing
+    # first when asked and the tree isn't already serving-quantized).
+    cpu = cpu_device() if host_stage else None
+    if cpu is not None:
+        with jax.default_device(cpu):
+            params = load_params(directory)
+    else:
+        params = load_params(directory)
     tok_meta = dict(meta["tokenizer"])
     if tok_meta.get("type") == "hf" and not os.path.isabs(
         tok_meta.get("path", "")

@@ -42,10 +42,35 @@ DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
 
 def _cpu_device():
-    try:
-        return jax.local_devices(backend="cpu")[0]
-    except Exception:  # noqa: BLE001 — no CPU backend registered
-        return None
+    from .checkpoint import cpu_device
+
+    return cpu_device()
+
+
+def _to_default_device(a):
+    """jnp.asarray that also MOVES committed host arrays to the default
+    device (asarray alone is an identity on a CPU-committed jax.Array)."""
+    return jax.device_put(jnp.asarray(a))
+
+
+def _is_prequantized(params) -> bool:
+    """True when the params tree already holds serving-quantized leaves
+    ({"q","s"} int8 or {"q4","s4"} int4 dicts from quantize_params)."""
+    layers = params.get("layers", {}) if isinstance(params, dict) else {}
+    return any(
+        isinstance(v, dict) and ("q" in v or "q4" in v)
+        for v in layers.values()
+    )
+
+
+def _prequantized_mode(params) -> str:
+    """The dominant stored serving mode of a prequantized tree ("int4" when
+    any packed-nibble leaf exists — mixed trees are int4-with-int8-fallback
+    by construction)."""
+    for v in params.get("layers", {}).values():
+        if isinstance(v, dict) and "q4" in v:
+            return "int4"
+    return "int8"
 
 
 def _on_accelerator(params) -> bool:
@@ -143,6 +168,15 @@ class TPUEngine:
             self._moe_impl = "gather"
 
         if shardings is not None:
+            if _is_prequantized(params):
+                # prepared checkpoints store the FUSED single-chip layout
+                # (w_qkv/w_gateup), which has no TP sharding rule — a fused
+                # concat would interleave q/k/v columns across shards
+                raise ValueError(
+                    "prequantized (prepared) checkpoints are single-chip "
+                    "serving artifacts; sharded plans must load the dense "
+                    "source and quantize at load time (quantize='int8')"
+                )
             if quantize:
                 # unfused layout: each projection's output dim shards on tp,
                 # scales follow (sharding.py quantized-leaf rules); the
@@ -154,7 +188,30 @@ class TPUEngine:
             else:
                 self.params = shardings.put_params(params)
         else:
-            if quantize and not _on_accelerator(params):
+            if _is_prequantized(params):
+                # prepared serving checkpoint (scripts/prepare_model.py
+                # --quantize): the leaves are already {"q","s"}/{"q4","s4"}
+                # — restore straight to device, nothing to quantize. The
+                # STORED mode wins; flag a mismatched request rather than
+                # silently reporting the wrong precision.
+                stored = _prequantized_mode(params)
+                if quantize and quantize != stored:
+                    log.warning(
+                        "checkpoint stores %s serving weights; requested "
+                        "quantize=%s is ignored (re-run prepare_model to "
+                        "change the stored mode)", stored, quantize,
+                    )
+                elif not quantize:
+                    # info, not warning: benches/prepared checkpoints pass
+                    # quantized trees without a mode on purpose
+                    log.info(
+                        "serving prequantized %s weights (bf16 serving is "
+                        "unavailable for prepared-quantized trees)", stored,
+                    )
+                self.quant_mode = quantize = stored
+                self.quantized = True
+                self.params = jax.tree.map(_to_default_device, params)
+            elif quantize and not _on_accelerator(params):
                 # Host-resident params (GGUF load, checkpoints staged on
                 # CPU): quantize on the host CPU backend FIRST, then ship
                 # only the quantized leaves. Transferring dense bf16 and
@@ -169,15 +226,16 @@ class TPUEngine:
                     # explicit device_put: jnp.asarray on a CPU-committed
                     # jax.Array is an identity and would leave the weights
                     # host-resident (PCIe-speed decode)
-                    self.params = jax.tree.map(
-                        lambda a: jax.device_put(a), qp
-                    )
+                    self.params = jax.tree.map(_to_default_device, qp)
                 else:
                     self.params = model.quantize_params(
                         jax.tree.map(jnp.asarray, params), mode=quantize
                     )
             else:
-                self.params = jax.tree.map(jnp.asarray, params)
+                # _to_default_device, not jnp.asarray: checkpoint restores
+                # may hand CPU-COMMITTED jax.Arrays, which asarray would
+                # leave on the host
+                self.params = jax.tree.map(_to_default_device, params)
                 if quantize:
                     self.params = model.quantize_params(
                         self.params, mode=quantize

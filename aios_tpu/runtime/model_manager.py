@@ -338,7 +338,11 @@ class ModelManager:
             if ckpt_mod.is_model_checkpoint(str(p)):
                 # prepared aios-tpu checkpoint: params restore straight to
                 # device, no GGUF parse/dequant on the serving path
-                cfg, params, tokenizer = ckpt_mod.load_model_checkpoint(str(p))
+                # host-stage only when a quantize pass may follow; plain
+                # bf16 serving restores straight to the accelerator
+                cfg, params, tokenizer = ckpt_mod.load_model_checkpoint(
+                    str(p), host_stage=bool(self.quantize)
+                )
                 if context_length:
                     cfg = cfg.scaled(max_context=context_length)
                 return cfg, params, tokenizer

@@ -15,6 +15,10 @@ Usage:
 
 Options:
   --dtype bf16|f32     serving dtype for dense weights (default bf16)
+  --quantize int8|int4 save the SERVING-QUANTIZED layout instead of dense —
+                       LoadModel then restores {"q","s"}/{"q4","s4"} leaves
+                       straight to device with no quantization pass (and no
+                       dense-weights HBM transient) on the serving path
   --context N          override max_context recorded in the config
   --verify             run a short greedy generation after writing
 """
@@ -34,6 +38,7 @@ def main() -> int:
     ap.add_argument("source", help="GGUF file, HF dir, or synthetic://preset")
     ap.add_argument("out", help="output checkpoint directory")
     ap.add_argument("--dtype", default="bf16", choices=("bf16", "f32"))
+    ap.add_argument("--quantize", default="", choices=("", "int8", "int4"))
     ap.add_argument("--context", type=int, default=0)
     ap.add_argument("--verify", action="store_true")
     args = ap.parse_args()
@@ -64,6 +69,14 @@ def main() -> int:
         f"({time.time() - t0:.1f}s)",
         file=sys.stderr,
     )
+
+    if args.quantize:
+        from aios_tpu.engine import model as model_mod
+
+        t0 = time.time()
+        params = model_mod.quantize_params(params, mode=args.quantize)
+        print(f"quantized to {args.quantize} serving layout "
+              f"({time.time() - t0:.1f}s)", file=sys.stderr)
 
     t0 = time.time()
     ckpt.save_model_checkpoint(args.out, cfg, params, tokenizer)

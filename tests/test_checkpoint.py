@@ -143,3 +143,54 @@ def test_prepare_model_script(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert ckpt.is_model_checkpoint(str(out))
+
+
+def test_prepared_quantized_checkpoint_serves_without_requantize(tmp_path):
+    """prepare_model --quantize saves {"q","s"} serving leaves; restoring
+    through the model manager serves them as-is (no re-quantization, no
+    dense transient), and decode matches quantizing at load time."""
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import checkpoint as ckpt
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.engine.tokenizer import ByteTokenizer
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(31), dtype=jnp.float32)
+    qparams = M.quantize_params(params, mode="int8")
+    out_dir = tmp_path / "prepared-int8"
+    ckpt.save_model_checkpoint(str(out_dir), TINY_TEST, qparams, ByteTokenizer())
+
+    cfg2, params2, tok2 = ckpt.load_model_checkpoint(str(out_dir))
+    assert "q" in params2["layers"]["w_qkv"]
+    # engine with quantize set must NOT re-quantize already-quantized leaves
+    eng = TPUEngine(cfg2, params2, num_slots=2, max_context=64,
+                    cache_dtype=jnp.float32, quantize="int8")
+    ref = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                    cache_dtype=jnp.float32, quantize="int8")
+    prompt = [1, 5, 9, 2]
+    got = eng.generate(prompt, max_new_tokens=8, temperature=0.0)
+    want = ref.generate(prompt, max_new_tokens=8, temperature=0.0)
+    assert got == want
+
+
+def test_prequantized_checkpoint_refused_under_sharding_plan():
+    """Prepared quantized checkpoints are single-chip artifacts (fused
+    layout has no TP rule); a sharded engine must refuse them clearly."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(32), dtype=jnp.float32)
+    qp = M.quantize_params(params, mode="int8")
+    plan = ShardingPlan(build_mesh(tp=2, n_devices=2))
+    with pytest.raises(ValueError, match="single-chip"):
+        TPUEngine(TINY_TEST, qp, num_slots=2, max_context=64,
+                  shardings=plan, quantize="int8")

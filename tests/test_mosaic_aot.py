@@ -20,10 +20,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-# libtpu wants these before first init; harmless offline values
-os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-
 
 @pytest.fixture(scope="module")
 def rep_sharding():
@@ -34,6 +30,12 @@ def rep_sharding():
         import libtpu  # noqa: F401
     except ImportError:
         pytest.skip("libtpu not installed — no Mosaic AOT compiler here")
+
+    # libtpu wants these before its first init. Set here (not at module
+    # import) so collecting this file can't leak a fake 4-chip topology
+    # into a process that will talk to real TPU hardware.
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
 
     from jax.experimental import topologies
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
