@@ -125,10 +125,22 @@ def save_model_checkpoint(directory: str, cfg, params, tokenizer) -> None:
         tok_meta = {"type": "hf", "path": "tokenizer"}
     else:
         tok_meta = tokenizer_to_dict(tokenizer)
+    # record the stored serving-quantization mode so load can skip the
+    # host-staging hop (prequantized leaves restore straight to device —
+    # no quantize pass will follow)
+    quantized = None
+    layers = params.get("layers", {}) if isinstance(params, dict) else {}
+    for v in layers.values():
+        if isinstance(v, dict) and "q4" in v:
+            quantized = "int4"
+            break
+        if isinstance(v, dict) and "q" in v:
+            quantized = "int8"
     meta = {
         "format": "aios-tpu-model-v1",
         "config": dataclasses.asdict(cfg),
         "tokenizer": tok_meta,
+        "serving_quantized": quantized,
     }
     tmp = os.path.join(directory, MODEL_META_NAME + ".tmp")
     with open(tmp, "w") as fh:
@@ -165,9 +177,12 @@ def load_model_checkpoint(directory: str, host_stage: bool = True):
     # host_stage: restore onto the host CPU backend instead of the default
     # device. Needed when a quantize pass will follow — restoring a big
     # dense checkpoint straight to the accelerator and THEN quantizing
-    # would hold dense + quantized HBM at once (7B OOM). The engine does
-    # final placement either way (TPUEngine device_puts, host-quantizing
-    # first when asked and the tree isn't already serving-quantized).
+    # would hold dense + quantized HBM at once (7B OOM). Prequantized
+    # checkpoints (prepare_model --quantize) never need the hop: their
+    # leaves are final, so they restore straight to the default device.
+    # The engine does final placement either way.
+    if meta.get("serving_quantized"):
+        host_stage = False
     cpu = cpu_device() if host_stage else None
     if cpu is not None:
         with jax.default_device(cpu):

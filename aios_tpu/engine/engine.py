@@ -49,8 +49,10 @@ def _cpu_device():
 
 def _to_default_device(a):
     """jnp.asarray that also MOVES committed host arrays to the default
-    device (asarray alone is an identity on a CPU-committed jax.Array)."""
-    return jax.device_put(jnp.asarray(a))
+    backend's device. Both jnp.asarray AND bare jax.device_put(x) are
+    identities on an array already committed to any device (jax 0.9
+    semantics), so the target device must be explicit."""
+    return jax.device_put(jnp.asarray(a), jax.devices()[0])
 
 
 def _is_prequantized(params) -> bool:

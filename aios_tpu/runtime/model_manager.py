@@ -115,8 +115,14 @@ class ModelManager:
         # its default); AIOS_TPU_QUANTIZE=0 forces bf16 serving. CPU-fallback
         # backends keep dense weights — without the TPU int8 dot they would
         # re-dequantize every matmul.
+        # explicit = the operator chose a mode (param or env); auto-derived
+        # defaults must not argue with a prepared checkpoint's stored mode
+        self.quantize_explicit = quantize is not None
         if quantize is None:
             env = os.environ.get("AIOS_TPU_QUANTIZE", "").lower()
+            self.quantize_explicit = env in (
+                "0", "false", "off", "1", "true", "int8", "int4",
+            )
             if env in ("0", "false", "off"):
                 quantize = False
             elif env in ("1", "true", "int8"):
@@ -253,13 +259,21 @@ class ModelManager:
                         "AIOS_TPU_SEQ_SHARD_KV ignored for %s: needs "
                         "sp > 1 dividing context %d", name, ctx,
                     )
+            quantize = self.quantize
+            if quantize and not self.quantize_explicit:
+                from ..engine.engine import _is_prequantized
+
+                if _is_prequantized(params):
+                    # auto-derived default meets a prepared checkpoint:
+                    # serve the stored mode without a mismatch warning
+                    quantize = None
             engine = TPUEngine(
                 cfg,
                 params,
                 num_slots=self.num_slots,
                 max_context=ctx,
                 shardings=self.plan,
-                quantize=self.quantize,
+                quantize=quantize,
                 cache_dtype=cache_dtype,
                 **kw,
             )

@@ -22,7 +22,7 @@ import pytest
 
 
 @pytest.fixture(scope="module")
-def rep_sharding():
+def rep_sharding(request):
     # skip ONLY when libtpu itself is absent (non-TPU dev machine); any
     # other failure to build the topology is a real regression of this
     # module's CI gate and must fail loudly
@@ -31,11 +31,15 @@ def rep_sharding():
     except ImportError:
         pytest.skip("libtpu not installed — no Mosaic AOT compiler here")
 
-    # libtpu wants these before its first init. Set here (not at module
-    # import) so collecting this file can't leak a fake 4-chip topology
-    # into a process that will talk to real TPU hardware.
-    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    # libtpu wants these before its first init; restore after the module
+    # so the fake 4-chip topology can't leak into later tests that might
+    # initialize a real TPU backend in this process
+    mp = pytest.MonkeyPatch()
+    request.addfinalizer(mp.undo)
+    if "TPU_ACCELERATOR_TYPE" not in os.environ:
+        mp.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+    if "TPU_WORKER_HOSTNAMES" not in os.environ:
+        mp.setenv("TPU_WORKER_HOSTNAMES", "localhost")
 
     from jax.experimental import topologies
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -145,3 +149,52 @@ def test_aot_multiquery_verify_both_dtypes(rep_sharding):
         rep_sharding, ops.multiquery_decode_attention_int8,
         qt, kq, kq, ks, ks, lens, strides,
     )
+
+
+# ---------------------------------------------------------------------------
+# Composed serving graphs — the exact jit units bench.py dispatches
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_aot_decode_step_int8_kv_ragged(rep_sharding, monkeypatch):
+    """TinyLlama decode step with int8 KV + the ragged kernel family —
+    the A/B arm that failed on hardware in r3."""
+    monkeypatch.setenv("AIOS_TPU_INT8_RAGGED", "1")
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINYLLAMA_1_1B
+
+    cfg = TINYLLAMA_1_1B
+    params = M.init_quantized_params(cfg, jax.random.PRNGKey(0))
+    k, v = M.init_kv_cache(cfg, 8, 4096, jnp.int8)
+    ks, vs = M.init_kv_scales(cfg, 8, 4096)
+    toks = jnp.ones((8,), jnp.int32)
+    lens = jnp.ones((8,), jnp.int32)
+
+    def step(params, toks, lens, k, v, ks, vs):
+        return M.decode_step(params, cfg, toks, lens, k, v, kernels=True,
+                             cache_scales=(ks, vs))
+
+    args = (params, toks, lens, k, v, ks, vs)
+    sh = jax.tree.map(lambda a: rep_sharding, args)
+    jax.jit(step, in_shardings=sh).trace(*args).lower().compile()
+
+
+@pytest.mark.slow
+def test_aot_decode_step_int4_weights(rep_sharding):
+    """Mistral-7B decode step on int4 serving weights (headline bench)."""
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import MISTRAL_7B
+
+    cfg = MISTRAL_7B
+    params = M.init_quantized_params(cfg, jax.random.PRNGKey(0), mode="int4")
+    k, v = M.init_kv_cache(cfg, 8, 1024, jnp.bfloat16)
+    toks = jnp.ones((8,), jnp.int32)
+    lens = jnp.ones((8,), jnp.int32)
+
+    def step(params, toks, lens, k, v):
+        return M.decode_step(params, cfg, toks, lens, k, v, kernels=True)
+
+    args = (params, toks, lens, k, v)
+    sh = jax.tree.map(lambda a: rep_sharding, args)
+    jax.jit(step, in_shardings=sh).trace(*args).lower().compile()
