@@ -127,15 +127,11 @@ def save_model_checkpoint(directory: str, cfg, params, tokenizer) -> None:
         tok_meta = tokenizer_to_dict(tokenizer)
     # record the stored serving-quantization mode so load can skip the
     # host-staging hop (prequantized leaves restore straight to device —
-    # no quantize pass will follow)
-    quantized = None
-    layers = params.get("layers", {}) if isinstance(params, dict) else {}
-    for v in layers.values():
-        if isinstance(v, dict) and "q4" in v:
-            quantized = "int4"
-            break
-        if isinstance(v, dict) and "q" in v:
-            quantized = "int8"
+    # no quantize pass will follow); single source of truth for the
+    # detection lives in engine.py
+    from .engine import _is_prequantized, _prequantized_mode
+
+    quantized = _prequantized_mode(params) if _is_prequantized(params) else None
     meta = {
         "format": "aios-tpu-model-v1",
         "config": dataclasses.asdict(cfg),

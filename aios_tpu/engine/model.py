@@ -101,7 +101,7 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
 
 def quantize_params(
     params: Params, include_head: bool = True, fuse: bool = True,
-    mode: str = "int8",
+    mode: str = "int8", target: str = "auto",
 ) -> Params:
     """Convert matmul weights to int8 serving leaves {"q": int8, "s": f32}.
 
@@ -165,9 +165,13 @@ def quantize_params(
             # kernel-ineligible dims fall back to int8 there. Off-TPU every
             # quantized leaf dequantizes inline anyway, so storage
             # eligibility is enough (keeps tiny test geometries on int4).
+            # ``target="tpu"`` forces the strict kernel rule regardless of
+            # the local backend — prepare_model uses it so a checkpoint
+            # prepared on a CPU build box never bakes in leaves a TPU
+            # can only serve through the HBM-dequant path.
             eligible = supports_int4(K, N) and (
                 kernel_supported(K, N, pick_group(K))
-                or not ops.use_pallas()
+                or (target != "tpu" and not ops.use_pallas())
             )
             if eligible:
                 p, s = quantize_int4(w)

@@ -116,13 +116,13 @@ class ModelManager:
         # backends keep dense weights — without the TPU int8 dot they would
         # re-dequantize every matmul.
         # explicit = the operator chose a mode (param or env); auto-derived
-        # defaults must not argue with a prepared checkpoint's stored mode
+        # defaults must not argue with a prepared checkpoint's stored mode.
+        # Derived as "did not fall through to the auto branch" so the
+        # recognized-value list exists in exactly one place (the chain).
         self.quantize_explicit = quantize is not None
         if quantize is None:
+            self.quantize_explicit = True
             env = os.environ.get("AIOS_TPU_QUANTIZE", "").lower()
-            self.quantize_explicit = env in (
-                "0", "false", "off", "1", "true", "int8", "int4",
-            )
             if env in ("0", "false", "off"):
                 quantize = False
             elif env in ("1", "true", "int8"):
@@ -133,6 +133,7 @@ class ModelManager:
                 # reference's GGUF serving format
                 quantize = "int4"
             else:
+                self.quantize_explicit = False  # fell through to auto
                 if env:
                     log.warning(
                         "unrecognized AIOS_TPU_QUANTIZE=%r (expected 0/1/"

@@ -74,7 +74,11 @@ def main() -> int:
         from aios_tpu.engine import model as model_mod
 
         t0 = time.time()
-        params = model_mod.quantize_params(params, mode=args.quantize)
+        # target="tpu": strict kernel eligibility, so preparing on a CPU
+        # build box never bakes in int4 leaves a TPU can't kernel-serve
+        params = model_mod.quantize_params(
+            params, mode=args.quantize, target="tpu"
+        )
         print(f"quantized to {args.quantize} serving layout "
               f"({time.time() - t0:.1f}s)", file=sys.stderr)
 
