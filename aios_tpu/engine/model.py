@@ -131,6 +131,8 @@ def quantize_params(
     """
     if mode not in ("int8", "int4"):
         raise ValueError(f"unknown weight quantization mode {mode!r}")
+    if target not in ("auto", "tpu"):
+        raise ValueError(f"unknown quantization target {target!r}")
     out = dict(params)
     src = params["layers"]
     layers = {

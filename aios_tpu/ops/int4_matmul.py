@@ -67,23 +67,59 @@ def kernel_supported(K: int, N: int, group: int) -> bool:
     return group % GROUP == 0 and N % 128 == 0 and K % group == 0
 
 
+# Clip-factor candidates for the per-group MSE search: pure round-to-
+# nearest (1.0) plus mild clipping. Clipping the group absmax shrinks the
+# quantization step for every inlier at the cost of saturating the few
+# outliers — on gaussian-ish weight groups the MSE-optimal factor is
+# usually 0.8-0.9, cutting RTN error ~20-30%.
+CLIP_CANDIDATES = (1.0, 0.9, 0.8, 0.7)
+
+
 def quantize_int4(
-    w: jnp.ndarray, group: int = None
+    w: jnp.ndarray, group: int = None, optimize_clip: bool = True
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Group-wise symmetric int4 quantization along the contraction dim.
 
     For ``w`` [..., K, N] returns (packed uint8 [..., K/2, N],
     scales f32 [..., K/group, 1, N]). Leading batch axes (stacked layers,
-    stacked experts) pass through.
+    stacked experts) pass through — and are mapped over one slice at a
+    time so the clip search's temporaries stay at one layer's footprint.
+
+    ``optimize_clip`` picks, per (group, output channel), the scale among
+    ``CLIP_CANDIDATES * absmax / 7`` minimizing the squared reconstruction
+    error (RTN-with-clip); disable for the exact legacy absmax behavior.
     """
     *lead, K, N = w.shape
     if group is None:
         group = pick_group(K)
     if not supports_int4(K, N, group):
         raise ValueError(f"no int4 group layout for weight shape {w.shape}")
-    wf = w.astype(jnp.float32).reshape(*lead, K // group, group, N)
+    if lead:
+        flat = w.reshape(-1, K, N)
+        packed, scales = jax.lax.map(
+            lambda x: quantize_int4(x, group, optimize_clip), flat
+        )
+        return (
+            packed.reshape(*lead, K // 2, N),
+            scales.reshape(*lead, K // group, 1, N),
+        )
+    wf = w.astype(jnp.float32).reshape(K // group, group, N)
     absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    if optimize_clip:
+        best_err = None
+        best_scale = scale
+        for c in CLIP_CANDIDATES:
+            s = jnp.where(absmax > 0, c * absmax / 7.0, 1.0)
+            qc = jnp.clip(jnp.round(wf / s), -8, 7)
+            err = jnp.sum((wf - qc * s) ** 2, axis=-2, keepdims=True)
+            if best_err is None:
+                best_err, best_scale = err, s
+            else:
+                take = err < best_err
+                best_err = jnp.where(take, err, best_err)
+                best_scale = jnp.where(take, s, best_scale)
+        scale = best_scale
     q = jnp.clip(jnp.round(wf / scale), -8, 7).astype(jnp.int32)
     # split-half packing within each group: low nibble rows [0, g/2),
     # high nibble rows [g/2, g)
