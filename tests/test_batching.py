@@ -200,3 +200,23 @@ def test_chat_templates():
     assert "<|im_start|>" in render_chat("qwen3-14b", "hi")
     out = render_chat("unknown-model", "hi", "sys")
     assert "User: hi" in out and "System: sys" in out
+
+
+def test_batcher_serves_int4_engine():
+    """The production batcher over an int4-quantized engine: batched greedy
+    output must match the same engine's direct generate (slot scheduling is
+    weight-format-agnostic)."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(7), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=4, max_context=128,
+        cache_dtype=jnp.float32, quantize="int4",
+    )
+    assert engine.quant_mode == "int4"
+    b = ContinuousBatcher(engine, chunk_steps=4, admit_chunk_steps=2)
+    try:
+        prompt = [3, 17, 91, 4, 55, 8]
+        want = engine.generate(prompt, max_new_tokens=10, temperature=0.0)
+        got = b.generate(prompt, max_tokens=10, temperature=0.0)
+        assert got == want
+    finally:
+        b.shutdown()
