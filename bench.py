@@ -802,6 +802,9 @@ def main() -> int:
     ap.add_argument("--virtual-ep", action="store_true",
                     help="run the expert-parallel MoE decode on a virtual CPU mesh")
     ap.add_argument("--skip-mistral", action="store_true")
+    ap.add_argument("--fast", action="store_true",
+                    help="headline decode configs only (no serving-feature "
+                         "A/Bs) — bounded-time mode for capped drivers")
     args = ap.parse_args()
 
     if args.virtual_tp:
@@ -887,6 +890,8 @@ def main() -> int:
         bench_paged_kv, bench_agent_ttft, bench_moe_gather,
         bench_int8_kv_ragged_ab, bench_orchestrator_e2e,
     ])
+    if args.fast:
+        extra = []
     for fn in extra:
         try:
             emit(fn())
