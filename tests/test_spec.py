@@ -273,3 +273,21 @@ def test_spec_max_tokens_respected(params):
     b.shutdown()
     eng.close()
     assert len(out) == 17
+
+
+def test_spec_generate_int4_weights(params):
+    """Speculative decoding over int4 serving weights: bit-identical to the
+    SAME int4 engine's plain greedy decode (draft/verify/accept is
+    weight-format-agnostic), and drafts actually accept."""
+    eng = make_engine(params, quantize="int4")
+    assert eng.quant_mode == "int4"
+    ref = eng.generate([1, 2, 3], max_new_tokens=64, temperature=0.0)
+    eng.close()
+    eng = make_engine(params, quantize="int4")
+    got = eng.generate(
+        [1, 2, 3], max_new_tokens=64, temperature=0.0, speculative=True
+    )
+    rounds = eng.decode_steps
+    eng.close()
+    assert got == ref
+    assert rounds < len(ref) - 1, f"no drafts accepted in {rounds} rounds"
