@@ -132,6 +132,31 @@ def save_model_checkpoint(directory: str, cfg, params, tokenizer) -> None:
     from .engine import _is_prequantized, _prequantized_mode
 
     quantized = _prequantized_mode(params) if _is_prequantized(params) else None
+    if quantized == "int4":
+        # Persisted int4 leaves must satisfy the STRICT kernel rule
+        # (target="tpu" in quantize_params): a storage-only q4 leaf baked
+        # on a CPU box would serve through the dequantize-in-HBM path on
+        # TPU — strictly worse than int8. Engine-load quantization uses
+        # target="auto", so re-check here, at the persistence boundary.
+        from ..ops.int4_matmul import kernel_supported
+
+        # the leaf's ACTUAL stored group is K / G where s4 is [..., G, 1, N]
+        # — pick_group(K) may differ when the leaf was quantized with an
+        # explicit smaller group
+        bad = [
+            key
+            for key, v in {**params["layers"], "lm_head": params.get("lm_head")}.items()
+            if isinstance(v, dict) and "q4" in v
+            for K, N in ((v["q4"].shape[-2] * 2, v["q4"].shape[-1]),)
+            if not kernel_supported(K, N, K // v["s4"].shape[-3])
+        ]
+        if bad:
+            raise ValueError(
+                "refusing to persist int4 leaves the TPU kernel cannot "
+                f"serve ({', '.join(bad)}): re-quantize with "
+                "quantize_params(..., target='tpu') (prepare_model does "
+                "this) so ineligible dims fall back to int8"
+            )
     meta = {
         "format": "aios-tpu-model-v1",
         "config": dataclasses.asdict(cfg),
@@ -159,7 +184,7 @@ def cpu_device():
         return None
 
 
-def load_model_checkpoint(directory: str, host_stage: bool = True):
+def load_model_checkpoint(directory: str, host_stage: bool = False):
     """Returns (cfg, params, tokenizer) from a prepared model directory."""
     import json
 
@@ -170,13 +195,15 @@ def load_model_checkpoint(directory: str, host_stage: bool = True):
     with open(os.path.join(directory, MODEL_META_NAME)) as fh:
         meta = json.load(fh)
     cfg = ModelConfig(**meta["config"])
-    # host_stage: restore onto the host CPU backend instead of the default
-    # device. Needed when a quantize pass will follow — restoring a big
+    # host_stage (opt-in): restore onto the host CPU backend instead of
+    # the default device. Callers that will quantize afterwards pass True
+    # (ModelManager does: host_stage=bool(quantize)) — restoring a big
     # dense checkpoint straight to the accelerator and THEN quantizing
-    # would hold dense + quantized HBM at once (7B OOM). Prequantized
+    # would hold dense + quantized HBM at once (7B OOM). Everyone else
+    # restores straight to device: defaulting to the host hop would tax
+    # every dense-bf16 restore with an extra copy + transfer. Prequantized
     # checkpoints (prepare_model --quantize) never need the hop: their
-    # leaves are final, so they restore straight to the default device.
-    # The engine does final placement either way.
+    # leaves are final. The engine does final placement either way.
     if meta.get("serving_quantized"):
         host_stage = False
     cpu = cpu_device() if host_stage else None

@@ -51,8 +51,16 @@ def _to_default_device(a):
     """jnp.asarray that also MOVES committed host arrays to the default
     backend's device. Both jnp.asarray AND bare jax.device_put(x) are
     identities on an array already committed to any device (jax 0.9
-    semantics), so the target device must be explicit."""
-    return jax.device_put(jnp.asarray(a), jax.devices()[0])
+    semantics), so the target device must be explicit. An operator-pinned
+    jax_default_device wins over devices()[0]."""
+    target = getattr(jax.config, "jax_default_device", None)
+    if isinstance(target, str):
+        # the config validator accepts platform-name strings ('cpu'/'tpu');
+        # device_put does not — resolve to that backend's first device
+        target = jax.devices(target)[0]
+    elif target is None:
+        target = jax.devices()[0]
+    return jax.device_put(jnp.asarray(a), target)
 
 
 def _is_prequantized(params) -> bool:

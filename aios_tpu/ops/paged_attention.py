@@ -189,6 +189,14 @@ def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
     B, H, D = q.shape
     N, P, KH = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
     quantized = scales is not None
+    if quantized and P % 128 and not interpret:
+        # Same Mosaic lane constraint as the ragged int8 kernel
+        # (decode_attention.py): the scale transpose below puts the page
+        # axis on lanes, so a non-128-aligned page_size would fail deep
+        # inside Mosaic instead of here.
+        raise ValueError(
+            f"int8 paged kernel needs a 128-aligned page_size, got {P}"
+        )
     kernel = functools.partial(
         _paged_decode_kernel,
         num_kv_heads=KH,

@@ -237,15 +237,20 @@ class ModelManager:
                         paged_pool_rows=self.paged_pool_rows, page_size=128,
                         prefix_cache=prefix,
                     )
-                elif ctx % 16 == 0:
+                elif ctx % 16 == 0 and cache_dtype != jnp.int8:
+                    # the int8 paged kernel needs 128-aligned pages
+                    # (_paged_call guard) — resolve that conflict HERE,
+                    # at the same altitude as the sibling config
+                    # conflicts, not as a load-time kernel ValueError
                     kw = dict(
                         paged_pool_rows=self.paged_pool_rows, page_size=16,
                         prefix_cache=prefix,
                     )
                 else:
                     log.warning(
-                        "AIOS_TPU_PAGED_KV ignored for %s: context %d is "
-                        "not a multiple of 16; serving dense", name, ctx,
+                        "AIOS_TPU_PAGED_KV ignored for %s: context %d "
+                        "needs a multiple of %d; serving dense", name, ctx,
+                        128 if cache_dtype == jnp.int8 else 16,
                     )
             if self.seq_shard_kv:
                 if kw:
@@ -261,13 +266,30 @@ class ModelManager:
                         "sp > 1 dividing context %d", name, ctx,
                     )
             quantize = self.quantize
-            if quantize and not self.quantize_explicit:
+            if not self.quantize_explicit:
                 from ..engine.engine import _is_prequantized
 
-                if _is_prequantized(params):
+                if quantize and _is_prequantized(params):
                     # auto-derived default meets a prepared checkpoint:
                     # serve the stored mode without a mismatch warning
                     quantize = None
+            elif not quantize:
+                from ..engine.engine import (
+                    _is_prequantized,
+                    _prequantized_mode,
+                )
+
+                if _is_prequantized(params):
+                    # the engine cannot distinguish explicit bf16 from
+                    # the auto default; surface the ignored override HERE,
+                    # where explicitness is known
+                    log.warning(
+                        "explicit bf16 request (quantize=False or "
+                        "AIOS_TPU_QUANTIZE=0) for %s ignored: checkpoint "
+                        "stores prepared %s serving weights (re-run "
+                        "prepare_model without --quantize for bf16 "
+                        "serving)", name, _prequantized_mode(params),
+                    )
             engine = TPUEngine(
                 cfg,
                 params,
