@@ -130,22 +130,18 @@ class TPUEngine:
         self.plan = shardings
         # normalize the quantize knob to a mode: True -> int8 (the measured
         # single-chip default), "int4" -> packed-nibble group-wise int4
-        # (ops/int4_matmul.py; half the int8 weight bytes). int4 is a
-        # per-device Pallas streaming path, so under a sharding plan (where
-        # matmuls are GSPMD-partitioned XLA dots) it downgrades to int8
-        # rather than serve a dequantize-in-HBM graph.
+        # (ops/int4_matmul.py; half the int8 weight bytes). Under a
+        # sharding plan int4 runs the kernel per device under shard_map
+        # (ShardingPlan.int4_matmul_impl) — column-parallel shards with no
+        # collective, row-parallel with the same tp psum GSPMD inserts for
+        # the int8 dots — so BASELINE config 4 (Mistral TP) serves the
+        # best weight format too.
         if quantize is True:
             quantize = "int8"
         elif not quantize:
             quantize = None
         elif quantize not in ("int8", "int4"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
-        if quantize == "int4" and shardings is not None:
-            log.warning(
-                "int4 serving is a single-chip Pallas path; sharded plan "
-                "serves int8 instead"
-            )
-            quantize = "int8"
         self.quant_mode = quantize
         self.quantized = quantize is not None
         # int8 KV cache: half the cache footprint/traffic; scales ride along
@@ -191,9 +187,15 @@ class TPUEngine:
                 # unfused layout: each projection's output dim shards on tp,
                 # scales follow (sharding.py quantized-leaf rules); the
                 # int8 x bf16 dot_generals partition like their dense
-                # counterparts, with GSPMD inserting the same psums
+                # counterparts, with GSPMD inserting the same psums. int4
+                # leaves quantize with SHARD-local eligibility/groups
+                # (tp=...) — dims whose shards the kernel can't serve fall
+                # back to int8 leaves.
                 self.params = shardings.put_params(
-                    model.quantize_params(params, fuse=False)
+                    model.quantize_params(
+                        params, fuse=False, mode=quantize,
+                        tp=shardings.tp,
+                    )
                 )
             else:
                 self.params = shardings.put_params(params)
@@ -285,12 +287,12 @@ class TPUEngine:
                 "seq_sharded_cache: the shard_map ragged kernel assumes "
                 "each device holds whole slots' context"
             )
+        on_tpu = False
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:
+            pass
         if shardings is not None and not self.quant_cache and not self.seq_sharded:
-            on_tpu = False
-            try:
-                on_tpu = jax.default_backend() == "tpu"
-            except Exception:
-                pass
             enable = (
                 sharded_attention
                 if sharded_attention is not None
@@ -300,6 +302,28 @@ class TPUEngine:
                 self._attn_impl = shardings.ragged_attention(
                     cfg.sliding_window, use_kernel=on_tpu
                 )
+
+        # int4 matmuls under a plan: matmul()'s default ladder would run
+        # the per-device Pallas kernel on GSPMD-sharded GLOBAL arrays, so
+        # every sharded consumer of q4 leaves must get an explicit impl —
+        #   * decode steps: shard_map per-device kernel (bandwidth-bound,
+        #     the path the int4 format exists for)
+        #   * prefill / chunked prefill / speculative verify: the jnp
+        #     reference body on global arrays, which GSPMD partitions like
+        #     any dot (compute-bound passes; the inline dequant is noise
+        #     there, and their [1, T, E] / [B, T, E] shapes don't fit the
+        #     decode-shaped shard_map specs)
+        self._qmm_impl = None
+        self._qmm_gspmd = None
+        if shardings is not None and quantize == "int4":
+            from ..ops.int4_matmul import int4_matmul_reference
+
+            self._qmm_impl = shardings.int4_matmul_impl(use_kernel=on_tpu)
+            self._qmm_gspmd = (
+                lambda x, leaf, kind: int4_matmul_reference(
+                    x, leaf["q4"], leaf["s4"]
+                )
+            )
 
         # Paged KV cache: HBM is reserved per page IN USE, not per
         # num_slots x max_context — many long-context slots oversubscribe a
@@ -454,6 +478,7 @@ class TPUEngine:
                     cache_scales=scales,
                     active=st["active"],
                     moe_impl=self._moe_impl,
+                    qmm=self._qmm_impl,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out
@@ -471,6 +496,7 @@ class TPUEngine:
                     cache_scales=(st["k_s"], st["v_s"]),
                     active=st["active"],
                     moe_impl=self._moe_impl,
+                    qmm=self._qmm_impl,
                 )
             else:
                 logits, k, v = model.decode_step(
@@ -484,6 +510,7 @@ class TPUEngine:
                     active=st["active"],
                     attn_impl=self._attn_impl,
                     moe_impl=self._moe_impl,
+                    qmm=self._qmm_impl,
                 )
             if mask is not None:
                 logits = logits + mask
@@ -571,6 +598,7 @@ class TPUEngine:
                     cache_scales=scales,
                     active=st["active"],
                     moe_impl=verify_moe_impl,
+                    qmm=self._qmm_gspmd,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out
@@ -589,6 +617,7 @@ class TPUEngine:
                     cache_scales=scales,
                     active=st["active"],
                     moe_impl=verify_moe_impl,
+                    qmm=self._qmm_gspmd,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out
@@ -643,7 +672,8 @@ class TPUEngine:
         map; rows in unbacked blocks land on the sacrificial page 0 and are
         never read)."""
         logits, ks, vs = model.prefill(
-            params, self.cfg, tokens, kernels=self._kernels
+            params, self.cfg, tokens, kernels=self._kernels,
+            qmm=self._qmm_gspmd,
         )
         T = tokens.shape[1]
         P = state["k"].shape[2]
@@ -693,7 +723,8 @@ class TPUEngine:
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p
     ):
         logits, ks, vs = model.prefill(
-            params, self.cfg, tokens, kernels=self._kernels
+            params, self.cfg, tokens, kernels=self._kernels,
+            qmm=self._qmm_gspmd,
         )
         # ks/vs [L, B=1, T, KH, D] -> cache layout [L, slot, T, KH, D]
         start = (0, slot, 0, 0, 0)
@@ -748,7 +779,7 @@ class TPUEngine:
             scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
             out = model.prefill_chunk_paged(
                 params, self.cfg, tokens, start, state["k"], state["v"],
-                table_row, cache_scales=scales,
+                table_row, cache_scales=scales, qmm=self._qmm_gspmd,
             )
             if self.quant_cache:
                 logits, upd["k"], upd["v"], (upd["k_s"], upd["v_s"]) = out
@@ -758,7 +789,7 @@ class TPUEngine:
             scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
             out = model.prefill_chunk(
                 params, self.cfg, tokens, slot, start, state["k"], state["v"],
-                cache_scales=scales,
+                cache_scales=scales, qmm=self._qmm_gspmd,
             )
             if self.quant_cache:
                 logits, upd["k"], upd["v"], (upd["k_s"], upd["v_s"]) = out

@@ -49,7 +49,7 @@ QUANT_KEYS = (
 )
 
 
-def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
+def matmul(x: jnp.ndarray, w, qmm=None, kind: str = "col") -> jnp.ndarray:
     """x @ w where w is a dense array, an int8 leaf {"q", "s"} or an int4
     leaf {"q4", "s4"}.
 
@@ -57,9 +57,18 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     mixed dot or the Pallas qmm; int4 via the packed-nibble Pallas kernel —
     a quarter of the bf16 decode bandwidth); elsewhere they dequantize
     inline.
+
+    ``qmm`` — explicit int4 matmul callable f(x, leaf, kind), overriding
+    the kernel ladder for q4 leaves; the tensor-parallel engine passes
+    ShardingPlan.int4_matmul_impl so each device runs the packed-nibble
+    kernel on its own shard under shard_map. ``kind`` names the Megatron
+    role of this matmul ("col" | "row" | "head") so the impl picks the
+    right specs + collective.
     """
     if isinstance(w, dict):
         if "q4" in w:
+            if qmm is not None:
+                return qmm(x, w, kind)
             from ..ops.int4_matmul import (
                 infer_group,
                 int4_matmul,
@@ -101,7 +110,7 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
 
 def quantize_params(
     params: Params, include_head: bool = True, fuse: bool = True,
-    mode: str = "int8", target: str = "auto",
+    mode: str = "int8", target: str = "auto", tp: int = 1,
 ) -> Params:
     """Convert matmul weights to int8 serving leaves {"q": int8, "s": f32}.
 
@@ -162,6 +171,18 @@ def quantize_params(
             )
 
             K, N = w.shape[-2], w.shape[-1]
+            # Under a tp-sharded plan the kernel runs per device on a
+            # [K, N/tp] (column-parallel) or [K/tp, N] (row-parallel)
+            # shard, so eligibility — and the scale-group size — must
+            # hold for the SHARD dims, not the global ones. lm_head
+            # shards its vocab like a column projection.
+            local_K, local_N = K, N
+            if tp > 1:
+                if key in ("wo", "w_down"):
+                    local_K = K // tp if K % tp == 0 else 0
+                else:
+                    local_N = N // tp if N % tp == 0 else 0
+            group = pick_group(local_K)
             # On TPU a q4 leaf the kernel can't serve would dequantize to
             # bf16 in HBM every step — strictly worse than int8 — so
             # kernel-ineligible dims fall back to int8 there. Off-TPU every
@@ -171,12 +192,17 @@ def quantize_params(
             # the local backend — prepare_model uses it so a checkpoint
             # prepared on a CPU build box never bakes in leaves a TPU
             # can only serve through the HBM-dequant path.
-            eligible = supports_int4(K, N) and (
-                kernel_supported(K, N, pick_group(K))
-                or (target != "tpu" and not ops.use_pallas())
+            eligible = (
+                local_K > 0
+                and local_N > 0
+                and supports_int4(K, N, group)
+                and (
+                    kernel_supported(local_K, local_N, group)
+                    or (target != "tpu" and not ops.use_pallas())
+                )
             )
             if eligible:
-                p, s = quantize_int4(w)
+                p, s = quantize_int4(w, group=group)
                 return {"q4": p, "s4": s}
         q, s = ops.quantize_int8(w, axis=-2)
         return {"q": q, "s": s}
@@ -323,21 +349,21 @@ def causal_mask(T: int, window: Optional[int]) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _project_qkv(x, lp, cfg: ModelConfig, cos, sin):
+def _project_qkv(x, lp, cfg: ModelConfig, cos, sin, qmm=None):
     B, T, E = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
     if "w_qkv" in lp:  # fused serving layout (quantize_params)
         Q, KV = cfg.q_dim, cfg.kv_dim
-        qkv = matmul(h, lp["w_qkv"])
+        qkv = matmul(h, lp["w_qkv"], qmm)
         q, k, v = (
             qkv[..., :Q],
             qkv[..., Q : Q + KV],
             qkv[..., Q + KV :],
         )
     else:
-        q = matmul(h, lp["wq"])
-        k = matmul(h, lp["wk"])
-        v = matmul(h, lp["wv"])
+        q = matmul(h, lp["wq"], qmm)
+        k = matmul(h, lp["wk"], qmm)
+        v = matmul(h, lp["wv"], qmm)
     q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -350,7 +376,7 @@ def _project_qkv(x, lp, cfg: ModelConfig, cos, sin):
 
 
 def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None,
-                with_aux: bool = False):
+                with_aux: bool = False, qmm=None):
     """One transformer block on [B, T, E]; returns (x', (k, v)) — or
     (x', (k, v, moe_aux)) when ``with_aux``.
 
@@ -360,18 +386,18 @@ def apply_block(x, lp, cfg: ModelConfig, cos, sin, mask, attention=None,
     """
     attention = attention or gqa_attention
     B, T = x.shape[0], x.shape[1]
-    q, k, v = _project_qkv(x, lp, cfg, cos, sin)
+    q, k, v = _project_qkv(x, lp, cfg, cos, sin, qmm)
     attn = attention(q, k, v, mask)
-    x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-    mlp_out, aux = _mlp_aux(x, lp, cfg, allow_dispatch=with_aux)
+    x = x + matmul(attn.reshape(B, T, -1), lp["wo"], qmm, "row")
+    mlp_out, aux = _mlp_aux(x, lp, cfg, allow_dispatch=with_aux, qmm=qmm)
     x = x + mlp_out
     if with_aux:
         return x, (k, v, aux)
     return x, (k, v)
 
 
-def _mlp(x, lp, cfg: ModelConfig, moe_impl: Optional[str] = None):
-    return _mlp_aux(x, lp, cfg, moe_impl=moe_impl)[0]
+def _mlp(x, lp, cfg: ModelConfig, moe_impl: Optional[str] = None, qmm=None):
+    return _mlp_aux(x, lp, cfg, moe_impl=moe_impl, qmm=qmm)[0]
 
 
 def _mlp_aux(
@@ -380,6 +406,7 @@ def _mlp_aux(
     cfg: ModelConfig,
     allow_dispatch: bool = False,
     moe_impl: Optional[str] = None,
+    qmm=None,
 ):
     """FFN sublayer; returns (out, moe_aux) — aux is the router
     load-balancing term (0.0 for dense models), consumed only by the
@@ -415,13 +442,13 @@ def _mlp_aux(
         return moe_mod.moe_ffn_dense(h, lp, cfg)
     if "w_gateup" in lp:  # fused serving layout (quantize_params)
         F = cfg.intermediate_size
-        gu = matmul(h, lp["w_gateup"])
+        gu = matmul(h, lp["w_gateup"], qmm)
         gate_pre, up = gu[..., :F], gu[..., F:]
     else:
-        gate_pre = matmul(h, lp["w_gate"])
-        up = matmul(h, lp["w_up"])
+        gate_pre = matmul(h, lp["w_gate"], qmm)
+        up = matmul(h, lp["w_up"], qmm)
     gate = jax.nn.silu(gate_pre.astype(jnp.float32)).astype(h.dtype)
-    return matmul(gate * up, lp["w_down"]), jnp.float32(0.0)
+    return matmul(gate * up, lp["w_down"], qmm, "row"), jnp.float32(0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -457,27 +484,28 @@ def forward_full(
 
 
 def prefill(
-    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, kernels=None
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, kernels=None,
+    qmm=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Causal forward returning (logits [B,T,V], k [L,B,T,KH,D], v [...]).
 
     The engine copies the returned K/V into the request's cache slot.
     """
-    return _forward_with_kv(params, cfg, tokens, kernels=kernels)
+    return _forward_with_kv(params, cfg, tokens, kernels=kernels, qmm=qmm)
 
 
 def _use_kernels(kernels: Optional[bool]) -> bool:
     return ops.use_pallas() if kernels is None else bool(kernels)
 
 
-def _final_logits(x: jnp.ndarray, params: Params, cfg: ModelConfig):
+def _final_logits(x: jnp.ndarray, params: Params, cfg: ModelConfig, qmm=None):
     """Shared tail of every entry point: final RMSNorm + (possibly tied,
     possibly int8) lm_head matmul; logits in fp32."""
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    return matmul(x, head).astype(jnp.float32)
+    return matmul(x, head, qmm, "head").astype(jnp.float32)
 
 
 def _ragged_min_c() -> int:
@@ -539,7 +567,7 @@ def _use_ragged_kernel(
 
 
 def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=None,
-                     with_aux: bool = False):
+                     with_aux: bool = False, qmm=None):
     B, T = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
@@ -559,14 +587,15 @@ def _forward_with_kv(params, cfg: ModelConfig, tokens, attn_fn=None, kernels=Non
     mask = causal_mask(T, cfg.sliding_window)
 
     def block(x, lp):
-        return apply_block(x, lp, cfg, cos, sin, mask, attention, with_aux)
+        return apply_block(x, lp, cfg, cos, sin, mask, attention, with_aux,
+                           qmm=qmm)
 
     if with_aux:
         x, (ks, vs, auxs) = jax.lax.scan(block, x, params["layers"])
-        logits = _final_logits(x, params, cfg)
+        logits = _final_logits(x, params, cfg, qmm)
         return logits, ks, vs, jnp.mean(auxs)
     x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
-    logits = _final_logits(x, params, cfg)
+    logits = _final_logits(x, params, cfg, qmm)
     return logits, ks, vs
 
 
@@ -579,6 +608,7 @@ def prefill_chunk(
     k_cache: jnp.ndarray,  # [L, S, C, KH, D]
     v_cache: jnp.ndarray,  # [L, S, C, KH, D]
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
 ):
     """One chunk of an incremental prefill against the slot cache.
 
@@ -630,7 +660,7 @@ def prefill_chunk(
         else:
             lp, k_l, v_l = layer
             k_s = v_s = None
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
         # k_new/v_new [1, Tc, KH, D] drop straight into the slot-cache layout
         # [S, C, KH, D] at (slot, start, 0, 0)
         if quant_cache:
@@ -660,8 +690,8 @@ def prefill_chunk(
             k_all = jax.lax.dynamic_slice_in_dim(k_l, slot, 1, axis=0)
             v_all = jax.lax.dynamic_slice_in_dim(v_l, slot, 1, axis=0)
         attn = attend(q, k_all.astype(q.dtype), v_all.astype(q.dtype))
-        x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
+        x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"], qmm, "row")
+        x = x + _mlp(x, lp, cfg, qmm=qmm)
         if quant_cache:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -675,7 +705,7 @@ def prefill_chunk(
         x, (k_cache, v_cache) = jax.lax.scan(
             block, x, (params["layers"], k_cache, v_cache)
         )
-    logits = _final_logits(x, params, cfg)
+    logits = _final_logits(x, params, cfg, qmm)
     if quant_cache:
         return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache
@@ -693,6 +723,7 @@ def decode_step(
     active: Optional[jnp.ndarray] = None,  # [B] bool
     attn_impl=None,  # (q [B,H,D], k_l, v_l, lengths) -> [B,H,D]
     moe_impl: Optional[str] = None,
+    qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
 ):
     """One batched decode step over the slot cache.
 
@@ -770,7 +801,7 @@ def decode_step(
         else:
             lp, k_l, v_l = layer
             k_s = v_s = None
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
         if quant_cache:
             kq, ks_new = quantize_kv(k_new[:, 0])
             vq, vs_new = quantize_kv(v_new[:, 0])
@@ -801,8 +832,8 @@ def decode_step(
                 )[:, None]
             else:
                 attn = gqa_attention(q, k_l, v_l, mask)
-        x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg, moe_impl)
+        x = x + matmul(attn.reshape(B, 1, -1), lp["wo"], qmm, "row")
+        x = x + _mlp(x, lp, cfg, moe_impl, qmm)
         if quant_cache:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -816,7 +847,7 @@ def decode_step(
         x, (k_cache, v_cache) = jax.lax.scan(
             block, x, (params["layers"], k_cache, v_cache)
         )
-    logits = _final_logits(x[:, 0], params, cfg)
+    logits = _final_logits(x[:, 0], params, cfg, qmm)
     if quant_cache:
         return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache
@@ -831,6 +862,7 @@ def prefill_chunk_paged(
     v_pool: jnp.ndarray,  # [L, N, P, KH, D]
     table_row: jnp.ndarray,  # [MB] int32 — the slot's block->page map
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
 ):
     """One chunk of an incremental prefill against the PAGED cache.
 
@@ -886,7 +918,7 @@ def prefill_chunk_paged(
         else:
             lp, k_l, v_l = layer
             k_s = v_s = None
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
         if quant_pool:
             k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new[0])
             v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new[0])
@@ -905,8 +937,8 @@ def prefill_chunk_paged(
             cfg.sliding_window,
             kv_tile,
         )
-        x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg)
+        x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"], qmm, "row")
+        x = x + _mlp(x, lp, cfg, qmm=qmm)
         if quant_pool:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -916,12 +948,12 @@ def prefill_chunk_paged(
         x, (k_pool, v_pool, k_scales, v_scales) = jax.lax.scan(
             block, x, (params["layers"], k_pool, v_pool, k_scales, v_scales)
         )
-        logits = _final_logits(x, params, cfg)
+        logits = _final_logits(x, params, cfg, qmm)
         return logits, k_pool, v_pool, (k_scales, v_scales)
     x, (k_pool, v_pool) = jax.lax.scan(
         block, x, (params["layers"], k_pool, v_pool)
     )
-    logits = _final_logits(x, params, cfg)
+    logits = _final_logits(x, params, cfg, qmm)
     return logits, k_pool, v_pool
 
 
@@ -937,6 +969,7 @@ def decode_step_paged(
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
     moe_impl: Optional[str] = None,
+    qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
 ):
     """One batched decode step over the PAGED slot cache.
 
@@ -1000,7 +1033,7 @@ def decode_step_paged(
         else:
             lp, k_l, v_l = layer
             k_s = v_s = None
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
         if quant_pool:
             k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new[:, 0])
             v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new[:, 0])
@@ -1029,8 +1062,8 @@ def decode_step_paged(
                     q[:, 0], k_l, v_l, tables, read_lengths,
                     window=cfg.sliding_window,
                 )[:, None]
-        x = x + matmul(attn.reshape(B, 1, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg, moe_impl)
+        x = x + matmul(attn.reshape(B, 1, -1), lp["wo"], qmm, "row")
+        x = x + _mlp(x, lp, cfg, moe_impl, qmm)
         if quant_pool:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -1040,12 +1073,12 @@ def decode_step_paged(
         x, (k_pool, v_pool, k_scales, v_scales) = jax.lax.scan(
             block, x, (params["layers"], k_pool, v_pool, k_scales, v_scales)
         )
-        logits = _final_logits(x[:, 0], params, cfg)
+        logits = _final_logits(x[:, 0], params, cfg, qmm)
         return logits, k_pool, v_pool, (k_scales, v_scales)
     x, (k_pool, v_pool) = jax.lax.scan(
         block, x, (params["layers"], k_pool, v_pool)
     )
-    logits = _final_logits(x[:, 0], params, cfg)
+    logits = _final_logits(x[:, 0], params, cfg, qmm)
     return logits, k_pool, v_pool
 
 
@@ -1060,6 +1093,7 @@ def verify_step_paged(
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
     moe_impl: Optional[str] = None,
+    qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
 ):
     """``verify_step`` over the PAGED cache: the T in-flight rows scatter
     through the page tables (inactive slots -> sacrificial page 0), and
@@ -1101,7 +1135,7 @@ def verify_step_paged(
         else:
             lp, k_l, v_l = layer
             k_s = v_s = None
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
         if quant_pool:
             k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new)
             v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new)
@@ -1115,8 +1149,8 @@ def verify_step_paged(
             k_all = k_l[tables].reshape(B, C, *k_l.shape[2:])
             v_all = v_l[tables].reshape(B, C, *v_l.shape[2:])
         attn = gqa_attention(q, k_all, v_all, mask)
-        x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg, moe_impl)
+        x = x + matmul(attn.reshape(B, T, -1), lp["wo"], qmm, "row")
+        x = x + _mlp(x, lp, cfg, moe_impl, qmm)
         if quant_pool:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -1126,12 +1160,12 @@ def verify_step_paged(
         x, (k_pool, v_pool, k_scales, v_scales) = jax.lax.scan(
             block, x, (params["layers"], k_pool, v_pool, k_scales, v_scales)
         )
-        logits = _final_logits(x, params, cfg)
+        logits = _final_logits(x, params, cfg, qmm)
         return logits, k_pool, v_pool, (k_scales, v_scales)
     x, (k_pool, v_pool) = jax.lax.scan(
         block, x, (params["layers"], k_pool, v_pool)
     )
-    logits = _final_logits(x, params, cfg)
+    logits = _final_logits(x, params, cfg, qmm)
     return logits, k_pool, v_pool
 
 
@@ -1146,6 +1180,7 @@ def verify_step(
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     active: Optional[jnp.ndarray] = None,  # [B] bool
     moe_impl: Optional[str] = None,
+    qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
 ):
     """Batched multi-token decode for speculative verification.
 
@@ -1225,7 +1260,7 @@ def verify_step(
         else:
             lp, k_l, v_l = layer
             k_s = v_s = None
-        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin)
+        q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
         if quant_cache:
             kq, ks_new = quantize_kv(k_new)  # [B, T, KH, D], [B, T, KH]
             vq, vs_new = quantize_kv(v_new)
@@ -1255,8 +1290,8 @@ def verify_step(
                 )
             else:
                 attn = gqa_attention(q, k_l, v_l, mask)
-        x = x + matmul(attn.reshape(B, T, -1), lp["wo"])
-        x = x + _mlp(x, lp, cfg, moe_impl)
+        x = x + matmul(attn.reshape(B, T, -1), lp["wo"], qmm, "row")
+        x = x + _mlp(x, lp, cfg, moe_impl, qmm)
         if quant_cache:
             return x, (k_l, v_l, k_s, v_s)
         return x, (k_l, v_l)
@@ -1270,7 +1305,7 @@ def verify_step(
         x, (k_cache, v_cache) = jax.lax.scan(
             block, x, (params["layers"], k_cache, v_cache)
         )
-    logits = _final_logits(x, params, cfg)
+    logits = _final_logits(x, params, cfg, qmm)
     if quant_cache:
         return logits, k_cache, v_cache, (k_scales, v_scales)
     return logits, k_cache, v_cache

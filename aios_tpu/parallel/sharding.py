@@ -115,6 +115,18 @@ class ShardingPlan:
                 if path.endswith("/q"):
                     return base
                 return P(*base[:-2], None, base[-1])
+        # int4 serving leaves {"q4", "s4"} (packed nibbles + group scales):
+        # q4 [..., K/2, N] shards exactly like the dense weight (nibble
+        # pairs never straddle a shard: K/tp stays even for every real
+        # geometry); s4 [..., G, 1, N] is the weight's spec with the
+        # contraction axis carrying the group axis and a fresh unsharded
+        # axis in front of N.
+        if path.endswith(("/q4", "/s4")):
+            base = PARAM_RULES.get(path[:-3])
+            if base is not None:
+                if path.endswith("/q4"):
+                    return base
+                return P(*base[:-1], None, base[-1])
         raise KeyError(f"no partition rule for param {path!r}")
 
     def params_shardings(self, params) -> Dict:
@@ -181,6 +193,86 @@ class ShardingPlan:
             out_specs=P("dp", "tp", None),
             check_rep=False,
         )
+
+    def int4_matmul_impl(self, use_kernel: bool):
+        """Per-device packed-nibble int4 matmuls under shard_map.
+
+        The int4 kernel (ops/int4_matmul.py) is a per-device Pallas
+        program, so under a sharding plan it cannot ride GSPMD like the
+        int8 dot_generals do. Same answer as ragged decode attention: run
+        the kernel on each device's weight shard under shard_map —
+        Megatron TP done by hand for exactly these matmuls.
+
+          col  — column-parallel (wq/wk/wv/w_gate/w_up): the output dim is
+                 tp-sharded, activations replicated; zero collectives.
+          row  — row-parallel (wo/w_down): the contraction dim (and its
+                 scale groups) is tp-sharded; a psum over tp completes the
+                 partial products — the same all-reduce GSPMD inserts for
+                 the dense/int8 layouts.
+          head — the lm_head [E, V] with vocab tp-sharded (col pattern on
+                 rank-2 activations [B, E]).
+
+        Each device picks kernel vs jnp reference from its LOCAL shard
+        dims (a shard can be kernel-ineligible even when the global shape
+        is not); ``use_kernel=False`` forces the reference body — how CPU
+        virtual meshes (dryrun, tests) exercise this path bit-for-bit.
+
+        Returns f(x, leaf, kind) -> y for model.matmul's ``qmm`` hook.
+        """
+        from jax.experimental.shard_map import shard_map
+
+        from ..ops.int4_matmul import (
+            infer_group,
+            int4_matmul,
+            int4_matmul_reference,
+            kernel_supported,
+        )
+
+        def local_mm(x_l, q4_l, s4_l):
+            g = infer_group(q4_l, s4_l)
+            if use_kernel and kernel_supported(
+                q4_l.shape[-2] * 2, q4_l.shape[-1], g
+            ):
+                return int4_matmul(x_l, q4_l, s4_l)
+            return int4_matmul_reference(x_l, q4_l, s4_l)
+
+        mesh = self.mesh
+        specs = {
+            # (x, q4, s4) in_specs, out_spec, psum over tp?
+            "col": (
+                (P("dp", None, None), P(None, "tp"), P(None, None, "tp")),
+                P("dp", None, "tp"),
+                False,
+            ),
+            "row": (
+                (P("dp", None, "tp"), P("tp", None), P("tp", None, None)),
+                P("dp", None, None),
+                True,
+            ),
+            "head": (
+                (P("dp", None), P(None, "tp"), P(None, None, "tp")),
+                P("dp", "tp"),
+                False,
+            ),
+        }
+        fns = {}
+        for kind, (in_specs, out_spec, reduce_tp) in specs.items():
+            def local(x_l, q4_l, s4_l, _reduce=reduce_tp):
+                y = local_mm(x_l, q4_l, s4_l)
+                return jax.lax.psum(y, "tp") if _reduce else y
+
+            fns[kind] = shard_map(
+                local,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_spec,
+                check_rep=False,
+            )
+
+        def qmm(x, leaf, kind):
+            return fns[kind](x, leaf["q4"], leaf["s4"])
+
+        return qmm
 
     @property
     def tp(self) -> int:

@@ -82,7 +82,19 @@ def test_aot_quantized_matmul(rep_sharding):
     aot_compile(rep_sharding, ops.quantized_matmul, x, w, s)
 
 
-@pytest.mark.parametrize("K,N", [(4096, 6144), (14336, 4096), (4096, 32000)])
+@pytest.mark.parametrize(
+    "K,N",
+    [
+        (4096, 6144), (14336, 4096), (4096, 32000),
+        # Mistral-7B TP-4 shard geometries (ShardingPlan.int4_matmul_impl
+        # runs the kernel per device on these): col shards [K, N/4] for
+        # wq / wk+wv / w_gate+w_up, row shards [K/4, N] for wo / w_down.
+        # (lm_head's 32000/4 = 8000 is not 128-aligned — quantize_params'
+        # tp-aware eligibility keeps that leaf int8, so no AOT case.)
+        (4096, 1024), (4096, 256), (4096, 3584),
+        (1024, 4096), (3584, 4096),
+    ],
+)
 def test_aot_int4_matmul(rep_sharding, K, N):
     from aios_tpu.ops.int4_matmul import GROUP, int4_matmul
 

@@ -467,3 +467,78 @@ def test_seq_sharded_cache_guards(cpu_devices):
         TPUEngine(cfg, params, num_slots=2, max_context=64,
                   cache_dtype=jnp.float32, shardings=plan,
                   seq_sharded_cache=True, paged_pool_rows=128)
+
+
+def test_tp_int4_weights_decode_matches_single_device(cpu_devices):
+    """int4 packed-nibble weights compose with a TP plan (VERDICT r3
+    item 3): the per-device shard_map int4 matmuls (col shards, row shards
+    + tp psum — ShardingPlan.int4_matmul_impl) must reproduce the
+    single-chip int4 engine's greedy decode exactly.
+
+    Geometry is chosen so the row-parallel scale groups coincide between
+    the single-chip and sharded quantizations (pick_group(K) ==
+    pick_group(K/tp) needs K/tp >= 128) — with matching groups the stored
+    q4/s4 values are identical and decode is bit-comparable.
+    """
+    from aios_tpu.engine.config import ModelConfig
+
+    cfg = ModelConfig(
+        name="tiny-int4-tp",
+        vocab_size=512,
+        hidden_size=256,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=64,
+        max_context=128,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = [3, 17, 91, 4, 55, 8]
+    ref = TPUEngine(
+        cfg, params, num_slots=4, max_context=64,
+        cache_dtype=jnp.float32, quantize="int4",
+    )
+    want = ref.generate(prompt, max_new_tokens=8)
+    assert ref.quant_mode == "int4"
+
+    plan = ShardingPlan(build_mesh(4, dp=2))  # dp=2 x tp=2
+    tp = TPUEngine(
+        cfg, params, num_slots=4, max_context=64,
+        cache_dtype=jnp.float32, quantize="int4", shardings=plan,
+    )
+    try:
+        # the plan must NOT have downgraded to int8: q4 leaves present
+        assert tp.quant_mode == "int4"
+        assert any(
+            isinstance(v, dict) and "q4" in v
+            for v in tp.params["layers"].values()
+        )
+        got = tp.generate(prompt, max_new_tokens=8)
+        assert got == want
+        # batched decode too: four slots stepping together
+        for s in range(4):
+            tp.prefill(s, [1 + s, 2, 3], temperature=0.0)
+            ref.prefill(s, [1 + s, 2, 3], temperature=0.0)
+        assert (tp.step(4) == ref.step(4)).all()
+    finally:
+        tp.close()
+        ref.close()
+
+
+def test_tp_int4_ineligible_dims_fall_back_to_int8(tiny_params, cpu_devices):
+    """TINY_TEST's row dims shard to K/tp < 128, where the shard-local
+    scale groups would diverge from the single-chip layout; the engine
+    still serves (per-leaf int8 fallback happens inside quantize_params
+    when shards are ineligible ON TPU; on CPU the storage path keeps q4)
+    and decode completes under the plan."""
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    eng = TPUEngine(
+        TINY_TEST, tiny_params, num_slots=4, max_context=64,
+        cache_dtype=jnp.float32, quantize="int4", shardings=plan,
+    )
+    try:
+        toks = eng.generate([3, 17, 91], max_new_tokens=4)
+        assert len(toks) == 4
+    finally:
+        eng.close()
