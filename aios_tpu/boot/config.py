@@ -41,7 +41,13 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             # (serving_env(); docs/CONFIG.md documents each)
             "quantize": "",          # "" = auto; "0"/"1"/"int8"/"int4"
             "kv_cache": "",          # "int8" halves KV footprint/traffic
-            "paged_kv_rows": 0,      # >0 = paged pool with this row budget
+            # paged KV pool + prompt-prefix cache: "auto" sizes the pool
+            # from the model's slots x context (dense-cache HBM + one
+            # slot of slack) — the production default, so the 8 agents'
+            # shared preambles hit the prefix index instead of re-
+            # prefilling (BASELINE.md <200 ms agent-response target).
+            # An integer sets a fixed row budget; 0 = dense slot cache.
+            "paged_kv_rows": "auto",
             "speculative": False,    # n-gram speculative decode
             "json_mode": "",         # "force" = reference json_object parity
             "guided_toolcalls": False,  # schema-guided reasoning replies
@@ -152,16 +158,20 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         put("AIOS_TPU_QUANTIZE", str(m["quantize"]))
     if m.get("kv_cache"):
         put("AIOS_TPU_KV_CACHE", str(m["kv_cache"]))
-    try:
-        rows = int(m.get("paged_kv_rows", 0) or 0)
-    except (TypeError, ValueError):
-        log.warning(
-            "[models] paged_kv_rows=%r is not an integer; ignored",
-            m.get("paged_kv_rows"),
-        )
-        rows = 0
-    if rows > 0:
-        put("AIOS_TPU_PAGED_KV", str(rows))
+    paged = m.get("paged_kv_rows", "auto")
+    if str(paged).strip().lower() == "auto":
+        put("AIOS_TPU_PAGED_KV", "auto")
+    else:
+        try:
+            rows = int(paged or 0)
+        except (TypeError, ValueError):
+            log.warning(
+                "[models] paged_kv_rows=%r is not an integer or 'auto'; "
+                "ignored", paged,
+            )
+            rows = 0
+        if rows > 0:
+            put("AIOS_TPU_PAGED_KV", str(rows))
     if m.get("speculative"):
         put("AIOS_TPU_SPECULATIVE", "1")
     if m.get("json_mode"):

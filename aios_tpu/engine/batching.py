@@ -119,6 +119,12 @@ class ContinuousBatcher:
         # tokens per slot per round — greedy requests decode the identical
         # sequence in fewer dispatches (engine/spec.py); sampling requests
         # transparently take their usual one token per round.
+        if speculative and not getattr(engine, "spec_supported", True):
+            log.warning(
+                "speculative decoding disabled: unsupported on this "
+                "engine config (dp-replicated page pool)"
+            )
+            speculative = False
         self.speculative = speculative
         self.spec_draft_len = spec_draft_len
         self.spec_ngram = spec_ngram
@@ -135,6 +141,14 @@ class ContinuousBatcher:
             self.prefill_chunk not in engine.buckets
             or engine.max_context % self.prefill_chunk
         ):
+            self.prefill_chunk = None
+        if self.prefill_chunk is not None and getattr(
+            engine, "pool_replicas", 1
+        ) > 1:
+            log.warning(
+                "chunked admission disabled: unsupported on a "
+                "dp-replicated page pool (whole-prompt prefill instead)"
+            )
             self.prefill_chunk = None
         # paged engines can run out of physical KV pages mid-stream; the
         # policy is to retire the LONGEST request (it has produced the most
@@ -303,13 +317,13 @@ class ContinuousBatcher:
             try:
                 first = pc.step()
                 break
-            except PoolExhausted:
+            except PoolExhausted as e:
                 # mid-admission exhaustion: free pages and retry the SAME
                 # chunk NOW — deferring to the next tick would let _admit()
                 # hand the freed pages to a new request and force another
                 # eviction. With nobody left to evict the admission itself
                 # is the victim (its partial pages release).
-                if not self._evict_longest():
+                if not self._evict_longest(e.replica):
                     self._prefilling = None
                     self._reserved_slot = -1
                     live.done = True
@@ -337,10 +351,16 @@ class ContinuousBatcher:
                 if not self._waiting:
                     return
                 live = self._waiting.popleft()
-            slot = free[0]
+            alloc = self.engine.allocator
+            if alloc is not None and alloc.replicas > 1:
+                # dp-partitioned pool: admit onto the replica with the
+                # most free pages — picking a starved replica would evict
+                # a live stream while another replica sits idle
+                slot = max(free, key=alloc.free_pages_for)
+            else:
+                slot = free[0]
             live.slot = slot
             ids = live.req.prompt_ids
-            alloc = self.engine.allocator
             need_rows = min(len(ids), self.engine.max_context - 1)
             window = self.engine.cfg.sliding_window
             if (
@@ -356,7 +376,7 @@ class ContinuousBatcher:
                 )
             if alloc is not None and alloc.blocks_for(
                 need_rows
-            ) > alloc.num_pages - 1:
+            ) > alloc.capacity_blocks():
                 # the prompt can NEVER fit the pool — fail it up front;
                 # evicting live requests one per tick would truncate every
                 # co-resident stream before reaching the same conclusion
@@ -393,10 +413,10 @@ class ContinuousBatcher:
                     temperature=live.req.temperature,
                     top_p=live.req.top_p,
                 )
-            except PoolExhausted:
+            except PoolExhausted as e:
                 with self._qlock:
                     self._waiting.appendleft(live)  # keep FIFO order
-                if not self._evict_longest():
+                if not self._evict_longest(e.replica):
                     # nothing to evict: the prompt is bigger than the whole
                     # pool — fail just this request, not the scheduler
                     with self._qlock:
@@ -445,13 +465,20 @@ class ContinuousBatcher:
         # (slot freed, counters bumped) is already final
         live.out_q.put(_END)
 
-    def _evict_longest(self) -> bool:
+    def _evict_longest(self, replica: int = None) -> bool:
         """Retire the live request with the most cache rows (frees the most
         pages) so a pool-exhausted dispatch can make progress. Returns
-        False when there is nothing to evict."""
+        False when there is nothing to evict. ``replica`` restricts the
+        hunt to requests whose slot lives on the starved replica of a
+        dp-partitioned pool — evicting elsewhere frees nothing useful."""
+        alloc = self.engine.allocator
         with self._lock:
             victims = sorted(
-                self._live.values(),
+                (
+                    l for l in self._live.values()
+                    if replica is None
+                    or alloc.replica_of(l.slot) == replica
+                ),
                 key=lambda l: self.engine.slot_length(l.slot),
             )
         if not victims:
@@ -538,8 +565,8 @@ class ContinuousBatcher:
             mask = jnp.stack(rows)
             try:
                 tokens = self.engine.step_masked(mask)
-            except PoolExhausted:
-                self._evict_longest()
+            except PoolExhausted as e:
+                self._evict_longest(e.replica)
                 return
             for slot, live in list(slots.items()):
                 if live.done:
@@ -566,8 +593,8 @@ class ContinuousBatcher:
                 tokens, counts = self.engine.spec_step(
                     n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
                 )
-            except PoolExhausted:
-                self._evict_longest()  # retry next tick, like the step path
+            except PoolExhausted as e:
+                self._evict_longest(e.replica)  # retry next tick
                 return
             for r in range(tokens.shape[0]):
                 for slot, live in list(slots.items()):
@@ -580,10 +607,10 @@ class ContinuousBatcher:
             return
         try:
             tokens = self.engine.step(n)  # [n, num_slots]
-        except PoolExhausted:
+        except PoolExhausted as e:
             # retire the longest request and retry on the next tick; the
             # failed ensure() left all engine state untouched
-            self._evict_longest()
+            self._evict_longest(e.replica)
             return
         for step_row in tokens:
             for slot, live in list(slots.items()):

@@ -334,15 +334,17 @@ class TPUEngine:
         self.allocator: Optional[paged.PageAllocator] = None
         self.prefix_index: Optional[paged.PrefixIndex] = None
         self._prefix_chunk: Optional[int] = None
+        self._pool_impl = None
+        self._paged_scatter = None
+        self.pool_replicas = 1
         if self.paged:
-            if shardings is not None and (
-                shardings.dp > 1 or shardings.sp > 1
-            ):
-                # the page pool is shared across ALL slots, so slots cannot
-                # shard over dp; TP is fine (pages shard kv heads only)
+            if shardings is not None and shardings.sp > 1:
+                # sp shards the CONTEXT axis; a page holds contiguous rows
+                # of one slot, so pages cannot split across sp shards —
+                # use seq_sharded_cache for sp-sharded long-context serving
                 raise ValueError(
-                    "paged KV cache composes with TP only (dp=sp=1): the "
-                    "shared page pool cannot split slots across dp shards"
+                    "paged KV cache composes with dp/tp only (sp=1): pages "
+                    "hold contiguous context rows and cannot shard over sp"
                 )
             if page_size < 1 or page_size & (page_size - 1):
                 # chunked admission relies on power-of-two chunk/page sizes
@@ -353,16 +355,43 @@ class TPUEngine:
                     f"max_context {self.max_context} must be a multiple of "
                     f"page_size {page_size}"
                 )
+            R = shardings.dp if shardings is not None else 1
+            self.pool_replicas = R
             max_blocks = self.max_context // page_size
-            num_pages = 1 + max(1, -(-int(paged_pool_rows) // page_size))
+            # per replica: one sacrificial page + its share of the pool
+            local_pages = 1 + max(
+                1, -(-int(paged_pool_rows) // (page_size * R))
+            )
+            num_pages = R * local_pages
             self.allocator = paged.PageAllocator(
-                num_pages, page_size, num_slots, max_blocks
+                num_pages, page_size, num_slots, max_blocks, replicas=R
             )
             shape = (
                 cfg.num_layers, num_pages, page_size,
                 cfg.num_kv_heads, cfg.head_dim,
             )
             k, v = jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype)
+            if R > 1:
+                # dp-replicated pool: page ops must run per device under
+                # shard_map (table ids are replica-local; a GSPMD gather
+                # could not prove locality and would all-gather the pool).
+                # Chunked admission and the prefix index stay off — both
+                # read the pool during per-slot admission, which the
+                # whole-prompt scatter path avoids.
+                self._pool_impl = shardings.paged_pool_impl(
+                    cfg.sliding_window, use_kernel=on_tpu,
+                    quantized=self.quant_cache,
+                )
+                self._paged_scatter = shardings.paged_prefill_scatter(
+                    quantized=self.quant_cache
+                )
+                self.prefill_chunk_default = 0  # instance override
+                if prefix_cache:
+                    log.info(
+                        "prefix cache disabled: pages are replica-local "
+                        "under a dp-partitioned pool"
+                    )
+                prefix_cache = False
             # Prefix caching rides on the page pool: prompts whose leading
             # full blocks hash-match an earlier prompt map those pages
             # instead of recomputing them (paged.PrefixIndex). The tail
@@ -385,6 +414,10 @@ class TPUEngine:
             k, v = model.init_kv_cache(
                 cfg, num_slots, self.max_context, cache_dtype
             )
+        # speculative verify does global pool scatters; under a
+        # dp-partitioned pool those need a shard_map twin that does not
+        # exist yet — refuse rather than corrupt replica-local pages
+        self.spec_supported = not (self.paged and self.pool_replicas > 1)
         if shardings is not None:
             k = shardings.put_cache(k, seq_shard=self.seq_sharded)
             v = shardings.put_cache(v, seq_shard=self.seq_sharded)
@@ -479,6 +512,7 @@ class TPUEngine:
                     active=st["active"],
                     moe_impl=self._moe_impl,
                     qmm=self._qmm_impl,
+                    pool_impl=self._pool_impl,
                 )
                 if self.quant_cache:
                     logits, k, v, (k_s, v_s) = out
@@ -683,7 +717,26 @@ class TPUEngine:
         pages = jnp.repeat(table_row[:nb], P)[:T]  # [T]
         offs = jnp.arange(T) % P
         # ks/vs [L, 1, T, KH, D] -> pool [L, N, P, KH, D]
-        if self.quant_cache:
+        if self._paged_scatter is not None:
+            # dp-replicated pool: table ids are replica-local, so the
+            # scatter must run per device (only the owning replica's
+            # writes target real pages — ShardingPlan.paged_prefill_scatter)
+            owner = self.allocator.replica_of(slot)
+            if self.quant_cache:
+                kq, ks_scale = model.quantize_kv(ks[:, 0])
+                vq, vs_scale = model.quantize_kv(vs[:, 0])
+                k, v, k_s, v_s = self._paged_scatter(
+                    state["k"], state["v"], state["k_s"], state["v_s"],
+                    kq, vq, ks_scale, vs_scale, pages, offs, owner,
+                )
+            else:
+                k, v = self._paged_scatter(
+                    state["k"], state["v"],
+                    ks[:, 0].astype(state["k"].dtype),
+                    vs[:, 0].astype(state["v"].dtype),
+                    pages, offs, owner,
+                )
+        elif self.quant_cache:
             kq, ks_scale = model.quantize_kv(ks[:, 0])  # [L, T, KH, D/·]
             vq, vs_scale = model.quantize_kv(vs[:, 0])
             k = state["k"].at[:, pages, offs].set(kq)
@@ -1101,6 +1154,12 @@ class TPUEngine:
                 f"chunk {chunk} must be a prefill bucket dividing "
                 f"max_context={self.max_context}"
             )
+        if self.pool_replicas > 1:
+            raise ValueError(
+                "chunked admission is unsupported with a dp-replicated "
+                "page pool (chunks read the pool during admission); use "
+                "whole-prompt prefill"
+            )
         ids = list(token_ids)[-(self.max_context - 1) :]
         matched, hashes = 0, []
         if self.prefix_index is not None:
@@ -1194,6 +1253,11 @@ class TPUEngine:
             )
         if ngram < 1:
             raise ValueError("ngram must be >= 1")
+        if not self.spec_supported:
+            raise ValueError(
+                "speculative decoding is unsupported with a dp-replicated "
+                "page pool (verify_step_paged has no shard_map pool twin)"
+            )
         with self._lock:
             if self.paged:
                 # worst case: full acceptance every round; unused pages
@@ -1338,7 +1402,7 @@ class TPUEngine:
             n = pc + tail
             if n > self.max_context - 1:
                 continue
-            if self.allocator.blocks_for(n) > self.allocator.num_pages - 1:
+            if self.allocator.blocks_for(n) > self.allocator.capacity_blocks():
                 continue  # pool too small for this prompt either way
             self.prefill(0, [7] * n, temperature=0.0)
             self.release(0)
@@ -1348,7 +1412,7 @@ class TPUEngine:
         for bucket in self.buckets:
             if self.paged and self.allocator.blocks_for(
                 bucket // 2 + 1
-            ) > self.allocator.free_pages:
+            ) > self.allocator.free_pages_for(0):
                 continue  # pool can't back prompts of this bucket anyway
             # length in (previous_bucket, bucket] so bucket_for() actually
             # selects THIS bucket — a fixed short prompt would bucket to 16
@@ -1368,7 +1432,7 @@ class TPUEngine:
                 n = min(ck + b // 2 + 1, self.max_context - 1)
                 if self.paged and self.allocator.blocks_for(
                     n
-                ) > self.allocator.free_pages:
+                ) > self.allocator.free_pages_for(0):
                     continue
                 pc = self.start_chunked_prefill(0, [1] * n, chunk=ck)
                 while pc.step() is None:

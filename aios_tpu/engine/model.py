@@ -970,6 +970,7 @@ def decode_step_paged(
     active: Optional[jnp.ndarray] = None,  # [B] bool
     moe_impl: Optional[str] = None,
     qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
+    pool_impl=None,  # per-device pool write+attend; see ShardingPlan
 ):
     """One batched decode step over the PAGED slot cache.
 
@@ -990,9 +991,7 @@ def decode_step_paged(
     (logits [B, V] fp32, k_pool', v_pool'[, (k_scales', v_scales')]).
     """
     B = tokens.shape[0]
-    MB = tables.shape[1]
     P = k_pool.shape[2]
-    C = MB * P
     quant_pool = cache_scales is not None
     use_kernel = _use_kernels(kernels) and not quant_pool
     # int8 pool through the paged kernel (same env gate as the dense int8
@@ -1017,16 +1016,6 @@ def decode_step_paged(
     x = params["embed"][tokens][:, None, :]  # [B, 1, E]
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
 
-    if quant_pool and not use_int8_kernel:
-        # layer-invariant mask, built once like decode_step's
-        cols = jnp.arange(C)[None, :]
-        mask = cols <= read_lengths[:, None]
-        if cfg.sliding_window is not None:
-            mask = mask & (
-                cols > (read_lengths[:, None] - cfg.sliding_window)
-            )
-        mask = mask[:, None, :]  # [B, 1, C]
-
     def block(x, layer):
         if quant_pool:
             lp, k_l, v_l, k_s, v_s = layer
@@ -1034,21 +1023,26 @@ def decode_step_paged(
             lp, k_l, v_l = layer
             k_s = v_s = None
         q, k_new, v_new = _project_qkv(x, lp, cfg, cos, sin, qmm)
-        if quant_pool:
+        if quant_pool and pool_impl is not None:
+            attn, k_l, v_l, k_s, v_s = pool_impl(
+                q[:, 0], k_new[:, 0], v_new[:, 0], k_l, v_l, k_s, v_s,
+                tables, read_lengths, pages, offs,
+            )
+            attn = attn[:, None]
+        elif quant_pool:
             k_l, k_s = scatter_quant(k_l, k_s, pages, offs, k_new[:, 0])
             v_l, v_s = scatter_quant(v_l, v_s, pages, offs, v_new[:, 0])
-            if use_int8_kernel:
-                attn = ops.paged_decode_attention_int8(
-                    q[:, 0], k_l, v_l, k_s, v_s, tables, read_lengths,
-                    window=cfg.sliding_window,
-                )[:, None]
-            else:
-                attn = gqa_attention(
-                    q,
-                    gather_dequant(k_l, k_s, tables, q.dtype),
-                    gather_dequant(v_l, v_s, tables, q.dtype),
-                    mask,
-                )
+            attn = paged_int8_attend(
+                q[:, 0], k_l, v_l, k_s, v_s, tables, read_lengths,
+                window=cfg.sliding_window,
+                use_int8_kernel=use_int8_kernel,
+            )[:, None]
+        elif pool_impl is not None:
+            attn, k_l, v_l = pool_impl(
+                q[:, 0], k_new[:, 0], v_new[:, 0], k_l, v_l, tables,
+                read_lengths, pages, offs,
+            )
+            attn = attn[:, None]
         else:
             k_l = k_l.at[pages, offs].set(k_new[:, 0].astype(k_l.dtype))
             v_l = v_l.at[pages, offs].set(v_new[:, 0].astype(v_l.dtype))
@@ -1472,6 +1466,31 @@ def init_kv_scales(
     """Per-(row, kv-head) scales for an int8 KV cache."""
     shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads)
     return jnp.ones(shape, jnp.float32), jnp.ones(shape, jnp.float32)
+
+
+def paged_int8_attend(q, k_l, v_l, k_s, v_s, tables, lengths, *, window,
+                      use_int8_kernel):
+    """Decode attention over an int8 page pool for ONE layer ([B,H,D] ->
+    [B,H,D]): the kernel path streams int8 pages with scales folded into
+    the dots; the XLA path dequantizes a gathered per-slot view. The single
+    source of truth for the int8-pool read — decode_step_paged AND the
+    dp-replicated shard_map body (sharding.paged_pool_impl) both call it,
+    so mask/window semantics cannot drift between them."""
+    if use_int8_kernel:
+        return ops.paged_decode_attention_int8(
+            q, k_l, v_l, k_s, v_s, tables, lengths, window=window
+        )
+    C = tables.shape[1] * k_l.shape[1]
+    cols = jnp.arange(C)[None, :]
+    mask = cols <= lengths[:, None]
+    if window is not None:
+        mask = mask & (cols > (lengths[:, None] - window))
+    return gqa_attention(
+        q[:, None],
+        gather_dequant(k_l, k_s, tables, q.dtype),
+        gather_dequant(v_l, v_s, tables, q.dtype),
+        mask[:, None, :],
+    )[:, 0]
 
 
 def scatter_quant(

@@ -30,30 +30,55 @@ SACRIFICIAL_PAGE = 0
 
 
 class PoolExhausted(RuntimeError):
-    """No free pages left to back a prefill/decode grow request."""
+    """No free pages left to back a prefill/decode grow request.
+    ``replica`` identifies the starved replica of a dp-partitioned pool
+    (0 for the unreplicated pool) so the batcher can evict a request that
+    actually frees pages there."""
 
-    def __init__(self, needed: int, free: int):
+    def __init__(self, needed: int, free: int, replica: int = 0):
         super().__init__(
-            f"KV page pool exhausted: need {needed} page(s), {free} free"
+            f"KV page pool exhausted: need {needed} page(s), {free} free "
+            f"(replica {replica})"
         )
         self.needed = needed
         self.free = free
+        self.replica = replica
 
 
 class PageAllocator:
     """Free-list allocator over ``num_pages`` physical pages of ``page_size``
-    rows, mapping ``num_slots`` slots x ``max_blocks`` logical blocks."""
+    rows, mapping ``num_slots`` slots x ``max_blocks`` logical blocks.
+
+    ``replicas`` partitions the pool for a dp-replicated serving plan:
+    the physical page axis shards over dp, so each replica owns a
+    contiguous range of ``num_pages / replicas`` pages and table entries
+    hold REPLICA-LOCAL ids (each device reads only its own slots' tables
+    under shard_map, so local ids need no translation on device). Every
+    replica's local page 0 is sacrificial. Slots map to replicas in
+    contiguous blocks — the same split GSPMD applies to the slot axis."""
 
     def __init__(self, num_pages: int, page_size: int, num_slots: int,
-                 max_blocks: int) -> None:
-        if num_pages < 2:
-            raise ValueError("need at least 2 pages (one is sacrificial)")
+                 max_blocks: int, replicas: int = 1) -> None:
+        if replicas < 1 or num_pages % replicas:
+            raise ValueError(
+                f"num_pages {num_pages} must divide into {replicas} replicas"
+            )
+        if num_slots % replicas:
+            raise ValueError(
+                f"num_slots {num_slots} must divide into {replicas} replicas"
+            )
+        if num_pages // replicas < 2:
+            raise ValueError("need at least 2 pages/replica (one sacrificial)")
         self.num_pages = num_pages
         self.page_size = page_size
         self.num_slots = num_slots
         self.max_blocks = max_blocks
-        # page 0 is the sacrificial page — never on the free list
-        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self.replicas = replicas
+        self.local_pages = num_pages // replicas
+        # local page 0 of every replica is sacrificial — never on a free list
+        self._free: List[List[int]] = [
+            list(range(self.local_pages - 1, 0, -1)) for _ in range(replicas)
+        ]
         # host copy of the device tables; unbacked entries map page 0
         self.tables = np.full((num_slots, max_blocks), SACRIFICIAL_PAGE,
                               dtype=np.int32)
@@ -63,26 +88,41 @@ class PageAllocator:
         self._trimmed = np.zeros(num_slots, dtype=np.int64)
         # pages mapped by more than one owner (prefix sharing) carry a
         # refcount; rc 0 means free
-        self._rc = np.zeros(num_pages, dtype=np.int64)
+        self._rc = np.zeros((replicas, self.local_pages), dtype=np.int64)
         # called with the shortfall when the free list runs dry; returns
         # how many pages it reclaimed (PrefixIndex.reclaim plugs in here)
         self.reclaimer: Optional[Callable[[int], int]] = None
 
+    def replica_of(self, slot: int) -> int:
+        return slot * self.replicas // self.num_slots
+
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
+
+    def free_pages_for(self, slot: int) -> int:
+        """Free pages in the replica that backs ``slot`` — the number
+        ``ensure`` can actually draw from (``free_pages`` sums across
+        replicas and overstates capacity when replicas > 1)."""
+        return len(self._free[self.replica_of(slot)])
+
+    def capacity_blocks(self) -> int:
+        """Most blocks ONE slot can ever hold: its replica's page count
+        minus the sacrificial page (== num_pages - 1 when unreplicated)."""
+        return self.local_pages - 1
 
     def pages_in_use(self) -> int:
-        return (self.num_pages - 1) - len(self._free)
+        return (self.num_pages - self.replicas) - self.free_pages
 
     def blocks_for(self, rows: int) -> int:
         return -(-rows // self.page_size)  # ceil
 
-    def _take(self, grow: int) -> None:
-        if grow > len(self._free) and self.reclaimer is not None:
-            self.reclaimer(grow - len(self._free))
-        if grow > len(self._free):
-            raise PoolExhausted(grow, len(self._free))
+    def _take(self, grow: int, replica: int = 0) -> None:
+        free = self._free[replica]
+        if grow > len(free) and self.reclaimer is not None:
+            self.reclaimer(grow - len(free))
+        if grow > len(free):
+            raise PoolExhausted(grow, len(free), replica)
 
     def ensure(self, slot: int, rows: int) -> bool:
         """Back slot ``slot`` for ``rows`` logical rows; allocates any
@@ -94,10 +134,11 @@ class PageAllocator:
         have = int(self._blocks_used[slot])
         if need <= have:
             return False
-        self._take(need - have)
+        r = self.replica_of(slot)
+        self._take(need - have, r)
         for b in range(have, need):
-            page = self._free.pop()
-            self._rc[page] = 1
+            page = self._free[r].pop()
+            self._rc[r, page] = 1
             self.tables[slot, b] = page
         self._blocks_used[slot] = need
         return True
@@ -107,27 +148,30 @@ class PageAllocator:
         leading blocks, taking a reference on each. The slot must be empty
         (fresh admission)."""
         assert int(self._blocks_used[slot]) == 0, "slot must be empty"
+        r = self.replica_of(slot)
         for b, page in enumerate(pages):
-            self._rc[page] += 1
+            self._rc[r, page] += 1
             self.tables[slot, b] = page
         self._blocks_used[slot] = len(pages)
 
-    def incref(self, page: int) -> None:
-        self._rc[page] += 1
+    def incref(self, page: int, replica: int = 0) -> None:
+        self._rc[replica, page] += 1
 
-    def decref(self, page: int) -> None:
-        self._rc[page] -= 1
-        if self._rc[page] == 0:
-            self._free.append(page)
-        assert self._rc[page] >= 0, f"page {page} refcount underflow"
+    def decref(self, page: int, replica: int = 0) -> None:
+        self._rc[replica, page] -= 1
+        if self._rc[replica, page] == 0:
+            self._free[replica].append(page)
+        assert self._rc[replica, page] >= 0, \
+            f"page {page} (replica {replica}) refcount underflow"
 
     def free_slot(self, slot: int) -> None:
         """Drop the slot's reference on each of its pages; pages whose
         refcount hits zero return to the free list (shared prefix pages
         survive under their other owners / the prefix index)."""
         used = int(self._blocks_used[slot])
+        r = self.replica_of(slot)
         for b in range(self._trimmed[slot], used):
-            self.decref(int(self.tables[slot, b]))
+            self.decref(int(self.tables[slot, b]), r)
         # trimmed entries were already decref'd — just restore the
         # "unbacked maps page 0" invariant for the whole row
         self.tables[slot, :used] = SACRIFICIAL_PAGE
@@ -143,11 +187,12 @@ class PageAllocator:
         entries keep their stale page ids, which is fine: they are never
         read and ``ensure`` never rewinds. Returns blocks freed now."""
         used = int(self._blocks_used[slot])
+        r = self.replica_of(slot)
         dead_rows = max(length - window, 0)
         dead = min(dead_rows // self.page_size, used)
         freed = 0
         for b in range(self._trimmed[slot], dead):
-            self.decref(int(self.tables[slot, b]))
+            self.decref(int(self.tables[slot, b]), r)
             freed += 1
         if dead > self._trimmed[slot]:
             self._trimmed[slot] = dead
@@ -198,6 +243,13 @@ class PrefixIndex:
     """
 
     def __init__(self, allocator: PageAllocator, max_pages: int) -> None:
+        if allocator.replicas != 1:
+            # prefix pages are replica-local under a dp-partitioned pool;
+            # cross-replica sharing is impossible, so the engine disables
+            # the index rather than serve replica-0-only hits
+            raise ValueError(
+                "PrefixIndex requires an unreplicated pool (replicas=1)"
+            )
         self.alloc = allocator
         self.max_pages = max_pages
         self._index: "OrderedDict[int, int]" = OrderedDict()  # hash -> page
@@ -250,7 +302,7 @@ class PrefixIndex:
             if freed >= n:
                 break
             page = self._index[h]
-            if self.alloc._rc[page] == 1:
+            if self.alloc._rc[0, page] == 1:
                 del self._index[h]
                 self.alloc.decref(page)
                 freed += 1

@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -273,6 +274,127 @@ class ShardingPlan:
             return fns[kind](x, leaf["q4"], leaf["s4"])
 
         return qmm
+
+    def paged_pool_impl(self, window: Optional[int], use_kernel: bool,
+                        quantized: bool):
+        """Per-device paged-pool write + attend under shard_map (dp > 1).
+
+        Under a dp-replicated plan the page pool's physical page axis
+        shards over dp and table entries are REPLICA-LOCAL ids
+        (engine/paged.py PageAllocator replicas=...). A GSPMD gather
+        through the tables could not prove locality and would all-gather
+        the pool; under shard_map each device scatters/gathers its own
+        slots' rows in its own pool shard — zero collectives, exactly the
+        single-chip paged path per device. kv heads additionally shard
+        over tp, like the dense cache.
+
+        Returns, for the bf16 pool,
+          f(q [B,H,D], k_new [B,KH,D], v_new, k_l [N,P,KH,D], v_l,
+            tables [B,MB], lengths [B], pages [B], offs [B])
+            -> (attn [B,H,D], k_l', v_l')
+        and for the int8 pool the same with (k_s [N,P,KH], v_s) appended
+        to inputs and outputs. Plugged into model.decode_step_paged's
+        ``pool_impl`` hook.
+        """
+        from jax.experimental.shard_map import shard_map
+
+        from .. import ops
+        from ..engine import model as model_mod
+
+        def local_bf16(q, k_new, v_new, k_l, v_l, tables, lengths, pages,
+                       offs):
+            k_l = k_l.at[pages, offs].set(k_new.astype(k_l.dtype))
+            v_l = v_l.at[pages, offs].set(v_new.astype(v_l.dtype))
+            if use_kernel:
+                attn = ops.paged_decode_attention(
+                    q, k_l, v_l, tables, lengths, window=window
+                )
+            else:
+                attn = ops.paged_decode_attention_reference(
+                    q, k_l, v_l, tables, lengths, window=window
+                )
+            return attn, k_l, v_l
+
+        def local_int8(q, k_new, v_new, k_l, v_l, k_s, v_s, tables,
+                       lengths, pages, offs):
+            k_l, k_s = model_mod.scatter_quant(k_l, k_s, pages, offs, k_new)
+            v_l, v_s = model_mod.scatter_quant(v_l, v_s, pages, offs, v_new)
+            attn = model_mod.paged_int8_attend(
+                q, k_l, v_l, k_s, v_s, tables, lengths, window=window,
+                use_int8_kernel=(
+                    use_kernel and model_mod._int8_ragged_enabled()
+                ),
+            )
+            return attn, k_l, v_l, k_s, v_s
+
+        pool = P("dp", None, "tp", None)
+        scale = P("dp", None, "tp")
+        vec = P("dp", "tp", None)
+        if quantized:
+            in_specs = (vec, vec, vec, pool, pool, scale, scale,
+                        P("dp", None), P("dp"), P("dp"), P("dp"))
+            out_specs = (vec, pool, pool, scale, scale)
+            fn = local_int8
+        else:
+            in_specs = (vec, vec, vec, pool, pool,
+                        P("dp", None), P("dp"), P("dp"), P("dp"))
+            out_specs = (vec, pool, pool)
+            fn = local_bf16
+        return shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+    def paged_prefill_scatter(self, quantized: bool):
+        """Per-device scatter of a whole prefilled prompt's K/V rows into
+        the dp-sharded page pool (replica-local page ids, like
+        ``paged_pool_impl``). The prompt's forward pass itself is
+        replicated over dp (B=1 — dp has nothing to split), so every
+        device computes the same rows; only the OWNING replica's scatter
+        targets real pages — the rest write their local sacrificial
+        page 0, which is never read.
+
+        bf16: f(k_pool [L,N,P,KH,D], v_pool, kq [L,T,KH,D], vq, pages [T],
+               offs [T], owner scalar) -> (k_pool', v_pool')
+        int8: scales [L,N,P,KH] and per-row scale values [L,T,KH] ride
+              along (inputs and outputs).
+        """
+        from jax.experimental.shard_map import shard_map
+
+        def local_bf16(k_l, v_l, kq, vq, pages, offs, owner):
+            mine = jax.lax.axis_index("dp") == owner
+            pg = jnp.where(mine, pages, 0)
+            k_l = k_l.at[:, pg, offs].set(kq.astype(k_l.dtype))
+            v_l = v_l.at[:, pg, offs].set(vq.astype(v_l.dtype))
+            return k_l, v_l
+
+        def local_int8(k_l, v_l, k_s, v_s, kq, vq, ks, vs, pages, offs,
+                       owner):
+            mine = jax.lax.axis_index("dp") == owner
+            pg = jnp.where(mine, pages, 0)
+            k_l = k_l.at[:, pg, offs].set(kq)
+            v_l = v_l.at[:, pg, offs].set(vq)
+            k_s = k_s.at[:, pg, offs].set(ks)
+            v_s = v_s.at[:, pg, offs].set(vs)
+            return k_l, v_l, k_s, v_s
+
+        pool = P(None, "dp", None, "tp", None)
+        scale = P(None, "dp", None, "tp")
+        rows = P(None, None, "tp", None)
+        rows_s = P(None, None, "tp")
+        if quantized:
+            in_specs = (pool, pool, scale, scale, rows, rows, rows_s,
+                        rows_s, P(None), P(None), P())
+            out_specs = (pool, pool, scale, scale)
+            fn = local_int8
+        else:
+            in_specs = (pool, pool, rows, rows, P(None), P(None), P())
+            out_specs = (pool, pool)
+            fn = local_bf16
+        return shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
 
     @property
     def tp(self) -> int:

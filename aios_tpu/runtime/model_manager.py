@@ -167,27 +167,44 @@ class ModelManager:
                 "using bf16",
                 kv_env,
             )
-        # AIOS_TPU_PAGED_KV=<rows> serves every model over a paged KV cache
-        # backed by a <rows>-row physical pool (engine/paged.py): slots x
-        # context becomes a logical limit, HBM is spent per page in use
-        self.paged_pool_rows: Optional[int] = None
-        paged_env = os.environ.get("AIOS_TPU_PAGED_KV", "")
-        if paged_env:
+        # AIOS_TPU_PAGED_KV serves every model over a paged KV cache
+        # (engine/paged.py): slots x context becomes a logical limit, HBM
+        # is spent per page in use, and prompt-prefix pages are SHARED
+        # across requests (paged.PrefixIndex) — the lever that takes the
+        # 8 agents' common preambles off the prefill path entirely.
+        #   <rows>  — fixed physical pool of that many rows
+        #   auto    — size per model at load: (num_slots + 1) x context
+        #             rows, i.e. the dense cache's HBM plus one slot's
+        #             worth of slack so prefix pages can outlive their
+        #             originating request without starving admissions.
+        #             The production boot config defaults to auto
+        #             (boot/config.py [models] paged_kv_rows).
+        #   0/off   — dense slot cache.
+        # Composes with tp and dp plans (dp partitions the pool per
+        # replica); sp-sharded contexts use AIOS_TPU_SEQ_SHARD_KV instead.
+        self.paged_pool_rows: Optional[Union[int, str]] = None
+        paged_env = os.environ.get("AIOS_TPU_PAGED_KV", "").lower()
+        if paged_env in ("auto",):
+            self.paged_pool_rows = "auto"
+        elif paged_env not in ("", "0", "off", "false"):
             try:
                 rows = int(paged_env)
             except ValueError:
                 rows = 0
-            tp_only = sharding_plan is None or (
-                sharding_plan.dp == 1 and sharding_plan.sp == 1
-            )
-            if rows > 0 and tp_only:
+            if rows > 0:
                 self.paged_pool_rows = rows
             else:
                 log.warning(
-                    "AIOS_TPU_PAGED_KV=%r ignored (need a positive row "
-                    "count; composes with TP-only plans, dp=sp=1)",
-                    paged_env,
+                    "AIOS_TPU_PAGED_KV=%r ignored (expected a positive "
+                    "row count, 'auto', or 0/off)", paged_env,
                 )
+        if self.paged_pool_rows is not None and sharding_plan is not None \
+                and sharding_plan.sp > 1:
+            log.warning(
+                "AIOS_TPU_PAGED_KV ignored: pages cannot shard over sp "
+                "(use AIOS_TPU_SEQ_SHARD_KV for sp-sharded contexts)"
+            )
+            self.paged_pool_rows = None
         # AIOS_TPU_SPECULATIVE=1 turns on n-gram speculative decode
         # dispatches (engine/spec.py): greedy agent requests — tool-call
         # JSON, quoted context — emit several tokens per verify round with
@@ -222,7 +239,11 @@ class ModelManager:
             cache_dtype = self.cache_dtype
             ctx = context_length or cfg.max_context
             kw = {}
-            if self.paged_pool_rows is not None:
+            pool_rows = self.paged_pool_rows
+            if pool_rows == "auto":
+                # dense-cache HBM + one slot of slack (prefix retention)
+                pool_rows = (self.num_slots + 1) * ctx
+            if pool_rows is not None:
                 # page size must divide the context; 128 aligns with the
                 # kernel block and every power-of-two bucket >= 128. An
                 # indivisible context degrades to the dense cache (like
@@ -234,7 +255,7 @@ class ModelManager:
                 ).lower() not in ("0", "false", "off")
                 if ctx % 128 == 0:
                     kw = dict(
-                        paged_pool_rows=self.paged_pool_rows, page_size=128,
+                        paged_pool_rows=pool_rows, page_size=128,
                         prefix_cache=prefix,
                     )
                 elif ctx % 16 == 0 and cache_dtype != jnp.int8:
@@ -243,7 +264,7 @@ class ModelManager:
                     # at the same altitude as the sibling config
                     # conflicts, not as a load-time kernel ValueError
                     kw = dict(
-                        paged_pool_rows=self.paged_pool_rows, page_size=16,
+                        paged_pool_rows=pool_rows, page_size=16,
                         prefix_cache=prefix,
                     )
                 else:

@@ -20,6 +20,9 @@ import pytest
 from aios_tpu.boot.config import AiosConfig, _default_sections
 from aios_tpu.boot.supervisor import ServiceDef, Supervisor, topo_sort
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -154,12 +157,22 @@ def test_serving_env_from_boot_config(tmp_path):
     for d in defs.values():
         assert d.env["AIOS_TPU_KV_CACHE"] == "int8"
 
-    # defaults: no knobs set -> no env injected (AiosConfig() directly;
-    # load_config(None) would read this HOST's /etc/aios config)
+    # defaults: the paged pool + prefix cache default ON ("auto" sizing);
+    # no other knob is injected (AiosConfig() directly; load_config(None)
+    # would read this HOST's /etc/aios config)
     from aios_tpu.boot.config import AiosConfig
 
-    assert serving_env(AiosConfig()) == {}
+    assert serving_env(AiosConfig()) == {"AIOS_TPU_PAGED_KV": "auto"}
+    # configless default_services injects nothing (no boot config at all)
     assert default_services()["runtime"].env == {}
+    assert default_services(AiosConfig())["runtime"].env == {
+        "AIOS_TPU_PAGED_KV": "auto"
+    }
+
+    # explicit 0 turns the pool off
+    off = tmp_path / "off.toml"
+    off.write_text("[models]\npaged_kv_rows = 0\n")
+    assert "AIOS_TPU_PAGED_KV" not in serving_env(load_config(str(off)))
 
     # env beats config: an operator-exported knob is not clobbered
     import os

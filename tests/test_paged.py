@@ -23,6 +23,9 @@ from aios_tpu.ops import (
     paged_decode_attention_reference,
 )
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def params():
@@ -683,11 +686,14 @@ def test_paged_pool_int8_under_tp(params, cpu_devices):
         ref.close()
 
 
-def test_paged_pool_refuses_dp_sharding(params, cpu_devices):
+def test_paged_pool_refuses_sp_sharding(params, cpu_devices):
+    """sp shards the context axis; pages hold contiguous context rows, so
+    the pool refuses sp>1 (dp>1 replicates the pool instead — covered by
+    test_parallel.py::test_paged_pool_dp_replicated_decode...)."""
     from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
 
-    plan = ShardingPlan(build_mesh(4, dp=2, tp=2))
-    with pytest.raises(ValueError, match="TP only"):
+    plan = ShardingPlan(build_mesh(4, sp=2, tp=2))
+    with pytest.raises(ValueError, match="sp=1"):
         TPUEngine(TINY_TEST, params, num_slots=4, max_context=256,
                   cache_dtype=jnp.float32, paged_pool_rows=256,
                   page_size=32, shardings=plan)

@@ -18,6 +18,9 @@ from jax.sharding import PartitionSpec as P
 
 from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tiny_params():
@@ -542,3 +545,65 @@ def test_tp_int4_ineligible_dims_fall_back_to_int8(tiny_params, cpu_devices):
         assert len(toks) == 4
     finally:
         eng.close()
+
+
+def test_paged_pool_dp_replicated_decode_matches_single_device(cpu_devices):
+    """Paged KV pool under a dp x tp plan (VERDICT r3 item 3): the pool's
+    page axis shards over dp with replica-local page tables, pool ops run
+    per device under shard_map (ShardingPlan.paged_pool_impl /
+    paged_prefill_scatter), and greedy decode matches the unreplicated
+    paged engine slot for slot — including slots owned by replica 1."""
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kw = dict(
+        num_slots=4, max_context=64, cache_dtype=jnp.float32,
+        paged_pool_rows=256, page_size=16,
+    )
+    ref = TPUEngine(cfg, params, **kw)
+    plan = ShardingPlan(build_mesh(4, dp=2))  # dp=2 x tp=2
+    eng = TPUEngine(cfg, params, shardings=plan, **kw)
+    try:
+        assert eng.paged and eng.pool_replicas == 2
+        assert eng.allocator.replicas == 2
+        assert eng.prefix_index is None  # replica-local pages: no sharing
+        # slot 0 (replica 0) and slot 3 (replica 1) prefill + batch decode
+        for s in (0, 1, 2, 3):
+            f_ref = ref.prefill(s, [2 + s, 7, 11, 13, 17], temperature=0.0)
+            f_eng = eng.prefill(s, [2 + s, 7, 11, 13, 17], temperature=0.0)
+            assert f_eng == f_ref, f"slot {s} first token diverged"
+        got = eng.step(6)
+        want = ref.step(6)
+        assert (got == want).all()
+        # replica-local allocation: slot 3's pages came from replica 1
+        assert eng.allocator.replica_of(3) == 1
+        # spec + chunked admission refuse cleanly under replication
+        with pytest.raises(ValueError, match="speculative"):
+            eng.spec_step(1, draft_len=2)
+        with pytest.raises(ValueError, match="chunked"):
+            eng.start_chunked_prefill(0, [1] * 40, chunk=16)
+    finally:
+        eng.close()
+        ref.close()
+
+
+def test_paged_pool_dp_replicated_int8_kv(cpu_devices):
+    """Same dp-replicated pool with the int8 KV pool: scatter_quant and
+    the dequantizing gather run inside the shard_map body."""
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kw = dict(
+        num_slots=2, max_context=64, cache_dtype=jnp.int8,
+        paged_pool_rows=192, page_size=16,
+    )
+    ref = TPUEngine(cfg, params, **kw)
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    eng = TPUEngine(cfg, params, shardings=plan, **kw)
+    try:
+        assert eng.prefill(0, [1, 2, 3, 4], temperature=0.0) == \
+            ref.prefill(0, [1, 2, 3, 4], temperature=0.0)
+        assert eng.prefill(1, [9, 8, 7], temperature=0.0) == \
+            ref.prefill(1, [9, 8, 7], temperature=0.0)
+        assert (eng.step(4) == ref.step(4)).all()
+    finally:
+        eng.close()
+        ref.close()

@@ -147,3 +147,24 @@ def test_load_error_returns_internal(runtime_stub):
             )
         )
     assert err.value.code() == grpc.StatusCode.INTERNAL
+
+
+def test_paged_auto_sizes_pool_from_slots_and_context(monkeypatch):
+    """AIOS_TPU_PAGED_KV=auto (the production boot default) serves over a
+    paged pool sized (num_slots + 1) x context with the prefix index on —
+    the dense cache's HBM plus one slot of prefix-retention slack."""
+    monkeypatch.setenv("AIOS_TPU_PAGED_KV", "auto")
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    assert mgr.paged_pool_rows == "auto"
+    m = mgr.load_model("tiny", "synthetic://tiny-test", context_length=128)
+    try:
+        eng = m.engine
+        assert eng.paged
+        assert eng.prefix_index is not None
+        rows = (2 + 1) * 128
+        # pool pages = 1 sacrificial + rows/page_size (page_size 128)
+        assert eng.allocator.num_pages == 1 + rows // 128
+    finally:
+        mgr.unload_model("tiny")
