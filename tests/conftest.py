@@ -38,3 +38,11 @@ def cpu_devices():
 @pytest.fixture()
 def tmp_db_path(tmp_path):
     return str(tmp_path / "test.db")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Everything not marked slow is the fast commit-gate tier
+    (`pytest -m fast` — service plane + runtime surface, <2 min on CPU)."""
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)

@@ -13,6 +13,9 @@ from aios_tpu.engine.config import TINY_TEST
 from aios_tpu.engine.engine import TPUEngine
 from aios_tpu.engine.tokenizer import ByteTokenizer, SentencePieceBPE, render_chat
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def batcher():

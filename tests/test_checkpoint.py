@@ -24,6 +24,9 @@ from aios_tpu.engine.tokenizer import (
     tokenizer_to_dict,
 )
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 def test_params_roundtrip(tmp_path):
     params = M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)

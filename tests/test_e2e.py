@@ -21,6 +21,9 @@ from aios_tpu.proto_gen import (
     runtime_pb2,
 )
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def stack(tmp_path_factory):

@@ -19,6 +19,9 @@ import pytest
 from aios_tpu import rpc, services
 from aios_tpu.proto_gen import common_pb2, orchestrator_pb2
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 class _ScriptedProvider(BaseHTTPRequestHandler):
     """OpenAI-protocol stub: pops scripted replies; records request bodies."""

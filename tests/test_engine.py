@@ -16,6 +16,9 @@ from aios_tpu.engine import sampling
 from aios_tpu.engine.config import TINY_TEST
 from aios_tpu.engine.engine import TPUEngine
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tiny_engine():

@@ -11,6 +11,9 @@ import pytest
 
 from aios_tpu.engine import gguf
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 def _rand_blocks(n_blocks, n_bytes, seed):
     rng = np.random.default_rng(seed)

@@ -23,6 +23,9 @@ from aios_tpu.engine.gguf import GGUFFile
 from aios_tpu.engine.tokenizer import SentencePieceBPE
 from aios_tpu.engine.weights import params_from_gguf
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 # ---------------------------------------------------------------------------
 # Independent GGUF v3 encoder (from the spec; no aios_tpu writer imports)
 # ---------------------------------------------------------------------------

@@ -23,6 +23,9 @@ from aios_tpu.engine.engine import TPUEngine
 from aios_tpu.engine.jsonmode import JsonConstraint
 from aios_tpu.engine.tokenizer import ByteTokenizer
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 TOOL_SCHEMA = {
     "type": "object",
     "properties": {

@@ -16,6 +16,9 @@ from aios_tpu.engine import model as M
 from aios_tpu.engine import weights as W
 from aios_tpu.engine.config import ModelConfig
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 ATOL = 2e-4
 RTOL = 2e-4
 

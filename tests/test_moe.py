@@ -25,6 +25,9 @@ from aios_tpu.engine.config import (
     from_hf_config,
 )
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 ATOL = 2e-4
 RTOL = 2e-4
 

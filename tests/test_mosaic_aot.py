@@ -20,6 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def rep_sharding(request):

@@ -22,6 +22,9 @@ import sys
 
 import pytest
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -27,6 +27,9 @@ from aios_tpu.ops.quantized_matmul import (
     supports_pallas_qmm,
 )
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 def _rand(key, shape, dtype=jnp.float32, scale=1.0):
     return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)

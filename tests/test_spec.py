@@ -17,6 +17,9 @@ from aios_tpu.engine.batching import ContinuousBatcher, Request
 from aios_tpu.engine.config import TINY_TEST
 from aios_tpu.engine.engine import TPUEngine
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def params():

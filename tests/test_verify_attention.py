@@ -17,6 +17,9 @@ from aios_tpu.ops import (
     multiquery_decode_attention_reference,
 )
 
+# compile-heavy tier: excluded from the fast commit gate (pytest -m fast)
+pytestmark = pytest.mark.slow
+
 
 def _setup(rng, B, C, KH, D, H, T):
     q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
