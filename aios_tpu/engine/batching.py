@@ -259,6 +259,14 @@ class ContinuousBatcher:
             self._schema_caches[key] = cache
             return cache
 
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (admission backlog) — the slot-
+        starvation signal the proactive generator watches."""
+        with self._qlock:
+            return len(self._waiting) + (
+                1 if self._prefilling is not None else 0
+            )
+
     def submit(self, req: Request) -> RequestHandle:
         if not req.prompt_ids:
             # fail fast on the caller's thread — an exception on the

@@ -147,6 +147,13 @@ class HealthChecker:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
+    def failure_snapshot(self) -> Dict[str, int]:
+        """Locked copy of the consecutive-failure counters — the health
+        thread mutates the dict under the lock, so readers (proactive
+        feed, console health panel) must not iterate it bare."""
+        with self._lock:
+            return dict(self.consecutive_failures)
+
     def probe(self, address: str, timeout: float = 2.0) -> bool:
         host, port = address.rsplit(":", 1)
         try:

@@ -127,6 +127,30 @@ def build_orchestrator(
         except grpc.RpcError:
             return []
 
+    def serving_stats() -> dict:
+        """Per-model serving counters from the runtime HealthCheck's
+        `<model>.serving` detail strings ("k=v,k=v") — the proactive
+        generator's pool-exhaustion / slot-starvation feed."""
+        try:
+            from ..proto_gen import common_pb2
+
+            resp = clients.runtime.HealthCheck(common_pb2.Empty(), timeout=5)
+        except grpc.RpcError:
+            return {}
+        out: dict = {}
+        for key, raw in resp.details.items():
+            if not key.endswith(".serving"):
+                continue
+            stats: dict = {}
+            for pair in raw.split(","):
+                k, _, v = pair.partition("=")
+                try:
+                    stats[k] = float(v)
+                except ValueError:
+                    continue
+            out[key[: -len(".serving")]] = stats
+        return out
+
     # --- components --------------------------------------------------------
 
     engine = GoalEngine(os.path.join(data_dir, "goals.db"))
@@ -186,8 +210,9 @@ def build_orchestrator(
         active_goal_descriptions=lambda: [
             g.description for g in engine.active_goals()
         ],
-        health_failures=lambda: dict(health.consecutive_failures),
+        health_failures=health.failure_snapshot,
         failed_agents=lambda: [a.agent_id for a in router.dead_agents()],
+        serving_stats=serving_stats,
     )
     service = OrchestratorService(
         engine=engine,
@@ -217,7 +242,13 @@ def run(
     scheduler.start()
     proactive.start()
     health.start()
-    console = ManagementConsole(service, port=console_port)
+    console = ManagementConsole(
+        service, port=console_port, serving_stats=serving_stats,
+        service_health=lambda: {
+            name: fails == 0
+            for name, fails in health.failure_snapshot().items()
+        },
+    )
     console.start()
 
     spawner = None

@@ -5,6 +5,17 @@ remediation goals on CPU > 90%, memory > 85%, disk > 90%, failed agents,
 >= 6 consecutive service-health failures, TLS certs expiring within 30 days,
 and backups staler than 24 h (proactive.rs:74-200), deduplicating against
 already-active goals.
+
+TPU-serving extension (no reference counterpart — llama-server exposes no
+serving counters): the runtime HealthCheck's per-model serving stats feed
+two escalations, mirroring the reference's health->goal pattern
+(proactive.rs:144-159):
+  * KV page-pool exhaustion — pool_evictions GREW since the last pass:
+    live streams are being truncated to admit new work (pool undersized
+    or a runaway long context);
+  * slot starvation — requests queued behind full slots
+    (waiting > 0 with every slot active) on two CONSECUTIVE passes, so a
+    transient burst does not page anyone.
 """
 
 from __future__ import annotations
@@ -30,6 +41,8 @@ class ProactiveConfig:
     backup_max_age_hours: float = 24.0
     cert_dir: str = "/tmp/aios/certs"
     backup_dir: str = "/tmp/aios/backups"
+    # serving escalations: consecutive starved passes before a goal
+    starvation_threshold: int = 2
 
 
 class ProactiveGenerator:
@@ -39,19 +52,29 @@ class ProactiveGenerator:
         active_goal_descriptions: Callable[[], List[str]],
         health_failures: Optional[Callable[[], dict]] = None,
         failed_agents: Optional[Callable[[], List[str]]] = None,
+        serving_stats: Optional[Callable[[], dict]] = None,
         config: Optional[ProactiveConfig] = None,
     ):
         self.submit_goal = submit_goal
         self.active_goal_descriptions = active_goal_descriptions
         self.health_failures = health_failures
         self.failed_agents = failed_agents
+        # model name -> {counter: float} from the runtime HealthCheck
+        # (orchestrator/main.py parses the `<model>.serving` details)
+        self.serving_stats = serving_stats
         self.config = config or ProactiveConfig()
+        self._evictions_seen: dict = {}
+        self._starved_passes: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def _maybe_submit(self, description: str, priority: int) -> bool:
-        """Dedupe against active goals (proactive.rs dedupe)."""
-        key = description.lower()[:40]
+        """Dedupe against active goals (proactive.rs dedupe). The key is
+        the description up to its first parenthetical — the parentheses
+        hold volatile readings (percentages, counts) while the prefix
+        carries the condition AND its subject (e.g. the model name), so
+        per-model escalations never collapse into one key."""
+        key = description.split("(")[0].strip().lower()[:80]
         for active in self.active_goal_descriptions():
             if key in active.lower():
                 return False
@@ -102,6 +125,52 @@ class ProactiveGenerator:
 
         created.extend(self._check_certs())
         created.extend(self._check_backups())
+        created.extend(self._check_serving())
+        return created
+
+    def _check_serving(self) -> List[str]:
+        """TPU serving escalations from the runtime's per-model counters."""
+        if self.serving_stats is None:
+            return []
+        created: List[str] = []
+        try:
+            per_model = self.serving_stats() or {}
+        except Exception:  # noqa: BLE001 — runtime down is the health
+            return []      # checker's escalation, not this one's
+        for model, stats in per_model.items():
+            ev = stats.get("pool_evictions", 0)
+            first_sighting = model not in self._evictions_seen
+            last = self._evictions_seen.get(model, ev)
+            self._evictions_seen[model] = ev
+            # pool_evictions is cumulative since RUNTIME start: on this
+            # generator's first sighting only record the baseline, or an
+            # orchestrator restart would report days-old evictions as new
+            if not first_sighting and ev > last:
+                if self._maybe_submit(
+                    f"Investigate KV page-pool exhaustion on model {model}"
+                    f" ({int(ev - last)} stream(s) evicted since last"
+                    " check; grow paged_kv_rows or shorten contexts)", 8,
+                ):
+                    created.append(f"pool:{model}")
+            slots = stats.get("num_slots", 0)
+            starved = (
+                stats.get("waiting", 0) > 0
+                and slots > 0
+                and stats.get("active_slots", 0) >= slots
+            )
+            if starved:
+                n = self._starved_passes.get(model, 0) + 1
+                self._starved_passes[model] = n
+                if n >= self.config.starvation_threshold:
+                    if self._maybe_submit(
+                        f"Relieve request starvation on model {model}"
+                        f" (all {int(slots)} slots busy with"
+                        f" {int(stats.get('waiting', 0))} request(s)"
+                        " queued; raise num_slots or add a replica)", 7,
+                    ):
+                        created.append(f"starvation:{model}")
+            else:
+                self._starved_passes[model] = 0
         return created
 
     def _check_certs(self) -> List[str]:

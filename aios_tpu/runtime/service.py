@@ -101,6 +101,8 @@ class RuntimeService(AIRuntimeServicer):
                 stats = engine.stats()
                 stats["pool_evictions"] = batcher.pool_evictions
                 stats["completed"] = batcher.completed
+                stats["waiting"] = batcher.queue_depth()
+                stats["num_slots"] = engine.num_slots
                 details[f"{m.name}.serving"] = ",".join(
                     f"{k}={v}" for k, v in sorted(stats.items())
                 )

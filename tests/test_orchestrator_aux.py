@@ -142,3 +142,91 @@ def test_decision_logger_ring_and_success_rate():
     rate = d.success_rate()
     assert rate is not None and 0.0 <= rate <= 1.0
     assert d.success_rate("no-such-context") is None
+
+
+# ---------------------------------------------------------------------------
+# Proactive serving escalations (VERDICT r3 item 7: engine.stats counters
+# -> remediation goals, mirroring proactive.rs:144-159's health->goal path)
+# ---------------------------------------------------------------------------
+
+
+def _proactive(stats_fn):
+    from aios_tpu.orchestrator.proactive import (
+        ProactiveConfig,
+        ProactiveGenerator,
+    )
+
+    goals = []
+    gen = ProactiveGenerator(
+        submit_goal=lambda d, p: goals.append((d, p)),
+        active_goal_descriptions=lambda: [d for d, _ in goals],
+        serving_stats=stats_fn,
+        # thresholds nothing on this box can trip, so only the serving
+        # rules fire
+        config=ProactiveConfig(
+            cpu_threshold=1000, memory_threshold=1000, disk_threshold=1000,
+            cert_dir="/nonexistent", backup_dir="/nonexistent",
+        ),
+    )
+    return gen, goals
+
+
+def test_starved_pool_yields_remediation_goal():
+    """Two consecutive starved passes (all slots busy + queued requests)
+    create ONE slot-starvation goal; a recovered pass resets the count."""
+    stats = {"tinyllama": {"active_slots": 8, "num_slots": 8, "waiting": 3}}
+    gen, goals = _proactive(lambda: stats)
+    assert gen.check_once() == []          # pass 1: armed, no goal yet
+    assert gen.check_once() == ["starvation:tinyllama"]
+    assert any("starvation" in d for d, _ in goals)
+    assert goals[0][1] == 7
+    # active goal dedupe: a third starved pass does not re-submit
+    assert gen.check_once() == []
+    # recovery resets the consecutive counter
+    stats["tinyllama"]["waiting"] = 0
+    gen.check_once()
+    assert gen._starved_passes["tinyllama"] == 0
+
+
+def test_pool_eviction_growth_yields_goal():
+    """pool_evictions increasing between passes (live streams truncated to
+    admit new work) creates a pool-exhaustion goal; a stable count does
+    not re-fire."""
+    stats = {"mistral": {"pool_evictions": 0, "active_slots": 1,
+                         "num_slots": 8, "waiting": 0}}
+    gen, goals = _proactive(lambda: stats)
+    assert gen.check_once() == []          # baseline recorded
+    stats["mistral"]["pool_evictions"] = 2
+    assert gen.check_once() == ["pool:mistral"]
+    assert any("page-pool exhaustion" in d for d, _ in goals)
+    assert goals[0][1] == 8
+    assert gen.check_once() == []          # stable count: no new goal
+
+
+def test_pool_eviction_history_is_baseline_not_alarm():
+    """pool_evictions is cumulative since RUNTIME start: a fresh
+    orchestrator seeing days-old evictions records the baseline instead
+    of paging anyone; two models escalate independently (the dedupe key
+    includes the model name, not a 40-char shared prefix)."""
+    stats = {"a-model": {"pool_evictions": 50, "active_slots": 0,
+                         "num_slots": 8, "waiting": 0},
+             "b-model": {"pool_evictions": 7, "active_slots": 0,
+                         "num_slots": 8, "waiting": 0}}
+    gen, goals = _proactive(lambda: stats)
+    assert gen.check_once() == []          # history -> baseline only
+    stats["a-model"]["pool_evictions"] = 51
+    assert gen.check_once() == ["pool:a-model"]
+    stats["b-model"]["pool_evictions"] = 9
+    # a-model's active goal must NOT suppress b-model's escalation
+    assert gen.check_once() == ["pool:b-model"]
+
+
+def test_serving_stats_failure_is_silent():
+    """A runtime that is down is the health checker's escalation, not a
+    serving-rule crash."""
+    def boom():
+        raise RuntimeError("runtime unreachable")
+
+    gen, goals = _proactive(boom)
+    assert gen.check_once() == []
+    assert goals == []

@@ -127,7 +127,14 @@ def test_system_status(orch):
 @pytest.fixture(scope="module")
 def console(orch):
     _, service = orch
-    c = ManagementConsole(service, port=0)
+    c = ManagementConsole(
+        service, port=0,
+        serving_stats=lambda: {
+            "tinyllama": {"active_slots": 2, "num_slots": 8,
+                          "decode_steps": 41, "waiting": 0}
+        },
+        service_health=lambda: {"runtime": True, "memory": True},
+    )
     c.start()
     yield f"http://127.0.0.1:{c.bound_port}"
     c.stop()
@@ -168,6 +175,16 @@ def test_console_dashboard_and_api(console):
     assert "tasks" in tasks
     agents = _get(console + "/api/agents")
     assert "agents" in agents
+
+    # reference-parity dashboard surfaces (management.rs:757+): goal
+    # drill-down + conversation thread + serving/health panels all have a
+    # UI path and the new /api/serving route serves the counters
+    assert "openGoal" in html and "subscribe_goal" in html
+    assert "TPU serving" in html and "Service health" in html
+    serving = _get(console + "/api/serving")
+    assert serving["models"]["tinyllama"]["decode_steps"] == 41
+    health2 = _get(console + "/api/health")
+    assert health2["services"] == {"runtime": True, "memory": True}
 
 
 # ---------------------------------------------------------------------------
