@@ -119,9 +119,15 @@ class TPUEngine:
         page_size: int = 128,
         prefix_cache: Optional[bool] = None,  # None -> on when paged
         seq_sharded_cache: bool = False,  # shard KV context axis over sp
+        track_history: bool = True,  # device-side token history (spec.py)
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
+        # Per-step history scatter exists ONLY for the n-gram speculative
+        # proposer (spec.py reads history[s, :length+1]); deployments with
+        # speculative decode off skip the write and its serial dependency
+        # in the decode scan (ModelManager passes track_history=spec).
+        self.track_history = bool(track_history)
         self.max_context = int(max_context or cfg.max_context)
         self.buckets = tuple(
             b for b in DEFAULT_BUCKETS if b <= self.max_context
@@ -492,9 +498,8 @@ class TPUEngine:
         the grammar-constraint hook (engine/jsonmode.py), step_masked only.
         """
 
-        def one(carry, _):
+        def one(carry, sub):
             st = carry
-            key, sub = jax.random.split(st["key"])
             if self.paged:
                 scales = (
                     (st["k_s"], st["v_s"]) if self.quant_cache else None
@@ -571,15 +576,24 @@ class TPUEngine:
                 "temps": st["temps"],
                 "top_ps": st["top_ps"],
                 "active": st["active"],
-                "history": st["history"].at[slots, hcol].set(next_tokens),
-                "key": key,
+                "history": (
+                    st["history"].at[slots, hcol].set(next_tokens)
+                    if self.track_history else st["history"]
+                ),
+                "key": st["key"],
             }
             if self.quant_cache:
                 st["k_s"] = k_s
                 st["v_s"] = v_s
             return st, next_tokens
 
-        state, tokens = jax.lax.scan(one, state, None, length=n_steps)
+        # one batched split for the whole dispatch instead of a split per
+        # step: keeps the threefry chain out of the scan's serial carry
+        # dependency (measurable at TinyLlama step times) — keys[0] becomes
+        # the next dispatch's base key, keys[1:] feed the steps
+        keys = jax.random.split(state["key"], n_steps + 1)
+        state = dict(state, key=keys[0])
+        state, tokens = jax.lax.scan(one, state, keys[1:])
         return state, tokens  # tokens [n_steps, S]
 
     def _spec_impl(
@@ -1257,6 +1271,11 @@ class TPUEngine:
             raise ValueError(
                 "speculative decoding is unsupported with a dp-replicated "
                 "page pool (verify_step_paged has no shard_map pool twin)"
+            )
+        if not self.track_history:
+            raise ValueError(
+                "speculative decoding needs the token history "
+                "(track_history=True; the n-gram proposer reads it)"
             )
         with self._lock:
             if self.paged:

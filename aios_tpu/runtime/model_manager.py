@@ -319,6 +319,10 @@ class ModelManager:
                 shardings=self.plan,
                 quantize=quantize,
                 cache_dtype=cache_dtype,
+                # the per-step history scatter serves only the n-gram
+                # speculative proposer — skip it (and its serial scan
+                # dependency) when speculative serving is off
+                track_history=self.speculative,
                 **kw,
             )
             del params

@@ -105,6 +105,9 @@ def bench_decode(name, cfg, *, num_slots, active_slots, max_context,
         num_slots=num_slots,
         max_context=max_context,
         cache_dtype=jnp.int8 if quant_kv else jnp.bfloat16,
+        # production default: speculative serving is off, so the decode
+        # scan skips the history scatter (ModelManager does the same)
+        track_history=False,
     )
     log(f"[{name}] params+engine in {time.time() - t0:.1f}s "
         f"({weight_bytes / 1e9:.2f} GB weights)")

@@ -4,28 +4,39 @@
 # TPU-native equivalent of /root/reference/scripts/download-models.sh: same
 # model set (the runtime's intelligence ladder, model_manager.rs:462-518),
 # same GGUF artifacts — the TPU runtime dequantizes GGUF into HBM-resident
-# int8/bf16 params at load (aios_tpu/engine/gguf.py) instead of handing the
-# file to llama.cpp.
+# int8/int4/bf16 params at load (aios_tpu/engine/gguf.py) instead of
+# handing the file to llama.cpp.
+#
+# Integrity: trust-on-first-use. The first successful download of each
+# file records its sha256 into $DEST/SHA256SUMS; every later run (and
+# --verify-only) checks against that record, so a corrupted re-download
+# or bit-rotted file fails loudly instead of producing garbage decode.
 #
 # Usage: scripts/download-models.sh [--dest DIR] [--tier tiny|tactical|all]
+#                                   [--verify-only]
 set -euo pipefail
 
 DEST=/var/lib/aios/models
 TIER=tiny
+VERIFY_ONLY=0
 
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --dest) DEST="$2"; shift 2 ;;
     --tier) TIER="$2"; shift 2 ;;
+    --verify-only) VERIFY_ONLY=1; shift ;;
     *) echo "unknown flag: $1" >&2; exit 2 ;;
   esac
 done
 
 mkdir -p "$DEST"
+SUMS="$DEST/SHA256SUMS"
+touch "$SUMS"
 
-# name|url|sha256 (sha256 empty = skip verification)
-TINY="tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|https://huggingface.co/TheBloke/TinyLlama-1.1B-Chat-v1.0-GGUF/resolve/main/tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|"
-MISTRAL="mistral-7b-instruct-v0.2.Q4_K_M.gguf|https://huggingface.co/TheBloke/Mistral-7B-Instruct-v0.2-GGUF/resolve/main/mistral-7b-instruct-v0.2.Q4_K_M.gguf|"
+# name|url|min_bytes (size sanity floor: a truncated or HTML-error
+# download is smaller than any real quantized model of the tier)
+TINY="tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|https://huggingface.co/TheBloke/TinyLlama-1.1B-Chat-v1.0-GGUF/resolve/main/tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|500000000"
+MISTRAL="mistral-7b-instruct-v0.2.Q4_K_M.gguf|https://huggingface.co/TheBloke/Mistral-7B-Instruct-v0.2-GGUF/resolve/main/mistral-7b-instruct-v0.2.Q4_K_M.gguf|4000000000"
 
 case "$TIER" in
   tiny)     MODELS=("$TINY") ;;
@@ -34,19 +45,78 @@ case "$TIER" in
   *) echo "unknown tier: $TIER" >&2; exit 2 ;;
 esac
 
+verify() {  # verify <file> against the recorded sum; 0=ok 1=bad 2=unrecorded
+  local f="$1" rec
+  rec=$(grep "  ${f##*/}\$" "$SUMS" | head -1 | cut -d' ' -f1) || true
+  [[ -z "$rec" ]] && return 2
+  echo "$rec  $f" | sha256sum -c --quiet - >/dev/null 2>&1
+}
+
+record() {
+  local f="$1" name sum
+  name="${f##*/}"
+  sum=$(sha256sum "$f" | cut -d' ' -f1)
+  grep -v "  $name\$" "$SUMS" > "$SUMS.tmp" || true
+  echo "$sum  $name" >> "$SUMS.tmp"
+  mv "$SUMS.tmp" "$SUMS"
+  echo "[models] recorded sha256 $sum for $name"
+}
+
+rc=0
 for spec in "${MODELS[@]}"; do
-  IFS='|' read -r name url sha <<< "$spec"
+  IFS='|' read -r name url min_bytes <<< "$spec"
   out="$DEST/$name"
   if [[ -f "$out" ]]; then
-    echo "[models] $name already present, skipping"
+    if verify "$out"; then
+      echo "[models] $name present and verified, skipping"
+      continue
+    elif [[ $? -eq 2 ]]; then
+      if [[ $VERIFY_ONLY -eq 1 ]]; then
+        # verify-only must never bless unverifiable state: recording the
+        # hash of a possibly-corrupt file would convert the corruption
+        # into the trusted baseline
+        echo "[models] $name present but UNRECORDED; re-run without" \
+             "--verify-only to record its checksum" >&2
+        rc=1
+      else
+        echo "[models] $name present (no recorded checksum); recording"
+        record "$out"
+      fi
+      continue
+    else
+      echo "[models] ERROR: $name fails its recorded sha256" >&2
+      rc=1
+      continue
+    fi
+  fi
+  if [[ $VERIFY_ONLY -eq 1 ]]; then
+    echo "[models] $name missing (verify-only mode)" >&2
+    rc=1
     continue
   fi
   echo "[models] fetching $name"
-  curl -fL --retry 3 --retry-delay 5 -o "$out.part" "$url"
-  if [[ -n "$sha" ]]; then
-    echo "$sha  $out.part" | sha256sum -c -
+  # -C - resumes a partial .part from a prior INTERRUPTED run (real prefix
+  # bytes); a curl failure must not abort the other models (set -e)
+  if ! curl -fL --retry 3 --retry-delay 5 -C - -o "$out.part" "$url"; then
+    echo "[models] ERROR: download failed for $name; .part kept for" \
+         "resume" >&2
+    rc=1
+    continue
+  fi
+  size=$(stat -c%s "$out.part")
+  if [[ "$size" -lt "$min_bytes" ]]; then
+    # a COMPLETED body below the floor is an interstitial/error page, not
+    # a partial transfer — resuming onto it would splice real bytes after
+    # garbage, so it must not survive
+    echo "[models] ERROR: $name completed at $size bytes (< $min_bytes" \
+         "floor) — error page or wrong artifact; discarding" >&2
+    rm -f "$out.part"
+    rc=1
+    continue
   fi
   mv "$out.part" "$out"
+  record "$out"
 done
 
-echo "[models] done; $(ls -lh "$DEST" | tail -n +2 | wc -l) file(s) in $DEST"
+echo "[models] done; $(ls "$DEST"/*.gguf 2>/dev/null | wc -l) model file(s) in $DEST"
+exit $rc
