@@ -224,6 +224,10 @@ def build_orchestrator(
         aggregator=aggregator,
         loaded_models=loaded_models,
     )
+    # run()'s console needs the same runtime-counters feed the proactive
+    # generator uses; the closure lives in this scope, so export it on the
+    # service object
+    service.serving_stats = serving_stats
     return service, autonomy, scheduler, proactive, health, event_bus
 
 
@@ -243,7 +247,8 @@ def run(
     proactive.start()
     health.start()
     console = ManagementConsole(
-        service, port=console_port, serving_stats=serving_stats,
+        service, port=console_port,
+        serving_stats=getattr(service, "serving_stats", None),
         service_health=lambda: {
             name: fails == 0
             for name, fails in health.failure_snapshot().items()

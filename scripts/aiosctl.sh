@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Operator CLI for a running aiOS-TPU stack.
+#
+# The reference ships service management inside its initd + systemctl tool
+# handlers (/root/reference/scripts/*, service.* tools); on a TPU VM the
+# equivalents are this script's probes against the five gRPC services and
+# the console's REST API.
+#
+# Usage: scripts/aiosctl.sh <command>
+#   status    one line per service: port reachability
+#   health    orchestrator + runtime health detail (console /api/*)
+#   serving   per-model TPU serving counters (slots, pages, prefix, queue)
+#   goals     recent goals through the console
+#   submit "<text>"   submit a goal
+#   logs [service]    tail the supervisor's per-service logs
+#   start|stop|restart    systemd unit control (install --systemd first)
+set -euo pipefail
+
+CONSOLE=${AIOS_CONSOLE:-http://127.0.0.1:9090}
+LOG_DIR=${AIOS_LOG_DIR:-/var/lib/aios/data/logs}
+
+declare -A PORTS=(
+  [orchestrator]=50051 [tools]=50052 [memory]=50053
+  [gateway]=50054 [runtime]=50055 [console]=9090
+)
+
+probe() {  # probe <host> <port>
+  (exec 3<>"/dev/tcp/$1/$2") 2>/dev/null && { exec 3>&-; return 0; } || return 1
+}
+
+cmd=${1:-status}
+case "$cmd" in
+  status)
+    rc=0
+    for name in orchestrator tools memory gateway runtime console; do
+      port=${PORTS[$name]}
+      if probe 127.0.0.1 "$port"; then
+        echo "$name :$port up"
+      else
+        echo "$name :$port DOWN"
+        rc=1
+      fi
+    done
+    exit $rc
+    ;;
+  health)
+    curl -fsS "$CONSOLE/api/health" && echo
+    curl -fsS "$CONSOLE/api/status" && echo
+    ;;
+  serving)
+    curl -fsS "$CONSOLE/api/serving" && echo
+    ;;
+  goals)
+    curl -fsS "$CONSOLE/api/goals" && echo
+    ;;
+  submit)
+    [[ $# -ge 2 ]] || { echo "usage: aiosctl.sh submit \"<goal>\"" >&2; exit 2; }
+    curl -fsS -X POST "$CONSOLE/api/goals" \
+      -H 'Content-Type: application/json' \
+      -d "{\"description\": $(python3 -c 'import json,sys; print(json.dumps(sys.argv[1]))' "$2")}" && echo
+    ;;
+  logs)
+    svc=${2:-}
+    if [[ -d "$LOG_DIR" ]]; then
+      if [[ -n "$svc" ]]; then
+        tail -n 100 -f "$LOG_DIR/$svc.log"
+      else
+        tail -n 20 "$LOG_DIR"/*.log
+      fi
+    elif command -v journalctl >/dev/null; then
+      journalctl -u aios.service -n 100 ${svc:+-g "$svc"} --no-pager
+    else
+      echo "no $LOG_DIR and no journalctl" >&2; exit 1
+    fi
+    ;;
+  start|stop|restart)
+    sudo systemctl "$cmd" aios.service
+    ;;
+  *)
+    echo "unknown command: $cmd (status|health|serving|goals|submit|logs|start|stop|restart)" >&2
+    exit 2
+    ;;
+esac

@@ -257,3 +257,28 @@ def test_client_cli_submit_and_status(orch_port, capsys):
     assert rc == 0
     status = json.loads(capsys.readouterr().out)
     assert status["description"] == "cli goal"
+
+
+def test_run_boots_console_with_serving_feed(tmp_path):
+    """run() (the module entrypoint the boot supervisor spawns) must wire
+    the console's serving feed from build_orchestrator's closure —
+    regression: a NameError here failed the whole stack's boot gate while
+    every test that used build_orchestrator directly stayed green."""
+    from aios_tpu.orchestrator.main import run
+
+    server, service, console, autonomy, spawner = run(
+        data_dir=str(tmp_path), grpc_address="127.0.0.1:0",
+        console_port=0, spawn_agents=False, block=False,
+    )
+    try:
+        assert console.bound_port
+        # the serving feed survived the build->run handoff (empty dict is
+        # fine — no runtime is up in this test)
+        assert console.serving_stats is not None
+        assert _get(f"http://127.0.0.1:{console.bound_port}/api/serving") == {
+            "models": {}
+        }
+    finally:
+        autonomy.stop()
+        console.stop()
+        server.stop(grace=None)
