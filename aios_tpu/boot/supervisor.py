@@ -244,6 +244,18 @@ def main() -> int:
         ",".join(hw.tpu_devices) or "none",
     )
     sup = Supervisor()
+
+    # SIGTERM must shut the tree down like SIGINT does: systemd's stop,
+    # a bare `kill`, and container runtimes all send TERM — without this
+    # the supervisor dies and ORPHANS all five services plus the agents
+    # (the reference's initd reaps its tree the same way)
+    import signal
+
+    def _term(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
+
     sup.boot()
     try:
         while True:

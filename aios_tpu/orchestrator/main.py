@@ -225,10 +225,11 @@ def build_orchestrator(
         loaded_models=loaded_models,
     )
     # run()'s console needs the same runtime-counters feed the proactive
-    # generator uses; the closure lives in this scope, so export it on the
-    # service object
-    service.serving_stats = serving_stats
-    return service, autonomy, scheduler, proactive, health, event_bus
+    # generator uses — return it explicitly so the wiring stays fail-fast
+    # (an ad-hoc service attribute + getattr fallback would degrade to a
+    # silent None feed on the next refactor)
+    return (service, autonomy, scheduler, proactive, health, event_bus,
+            serving_stats)
 
 
 def run(
@@ -239,16 +240,14 @@ def run(
     block: bool = True,
 ):
     """Boot the full orchestrator process (main.rs:592-798 equivalent)."""
-    service, autonomy, scheduler, proactive, health, _bus = build_orchestrator(
-        data_dir
-    )
+    (service, autonomy, scheduler, proactive, health, _bus,
+     serving_stats) = build_orchestrator(data_dir)
     autonomy.start()
     scheduler.start()
     proactive.start()
     health.start()
     console = ManagementConsole(
-        service, port=console_port,
-        serving_stats=getattr(service, "serving_stats", None),
+        service, port=console_port, serving_stats=serving_stats,
         service_health=lambda: {
             name: fails == 0
             for name, fails in health.failure_snapshot().items()
@@ -266,13 +265,26 @@ def run(
     server, service, port = serve(address=grpc_address, service=service,
                                   block=False)
     log.info("orchestrator up: grpc :%s console :%s", port, console.bound_port)
+
+    def shutdown():
+        """Stop every loop run() started (embedders/tests; the supervisor
+        child never calls it — it dies with the process)."""
+        autonomy.stop()
+        scheduler.stop()
+        proactive.stop()
+        health.stop()
+        if spawner is not None:
+            spawner.stop()
+        console.stop()
+        server.stop(grace=None)
+
     if block:
         try:
             while True:
                 time.sleep(3600)
         except KeyboardInterrupt:
             pass
-    return server, service, console, autonomy, spawner
+    return server, service, console, autonomy, spawner, shutdown
 
 
 if __name__ == "__main__":

@@ -19,13 +19,19 @@ set -euo pipefail
 CONSOLE=${AIOS_CONSOLE:-http://127.0.0.1:9090}
 LOG_DIR=${AIOS_LOG_DIR:-/var/lib/aios/data/logs}
 
+# console host:port derived from AIOS_CONSOLE so `status` probes the same
+# endpoint the REST subcommands talk to
+CONSOLE_HP=${CONSOLE#*://}; CONSOLE_HP=${CONSOLE_HP%%/*}
+CONSOLE_HOST=${CONSOLE_HP%%:*}
+CONSOLE_PORT=${CONSOLE_HP##*:}; [[ "$CONSOLE_PORT" == "$CONSOLE_HOST" ]] && CONSOLE_PORT=80
+
 declare -A PORTS=(
   [orchestrator]=50051 [tools]=50052 [memory]=50053
-  [gateway]=50054 [runtime]=50055 [console]=9090
+  [gateway]=50054 [runtime]=50055 [console]=$CONSOLE_PORT
 )
 
-probe() {  # probe <host> <port>
-  (exec 3<>"/dev/tcp/$1/$2") 2>/dev/null && { exec 3>&-; return 0; } || return 1
+probe() {  # probe <host> <port> — the subshell opens and closes the socket
+  (exec 3<>"/dev/tcp/$1/$2") 2>/dev/null
 }
 
 cmd=${1:-status}
@@ -34,7 +40,9 @@ case "$cmd" in
     rc=0
     for name in orchestrator tools memory gateway runtime console; do
       port=${PORTS[$name]}
-      if probe 127.0.0.1 "$port"; then
+      host=127.0.0.1
+      [[ "$name" == console ]] && host=$CONSOLE_HOST
+      if probe "$host" "$port"; then
         echo "$name :$port up"
       else
         echo "$name :$port DOWN"
@@ -62,10 +70,15 @@ case "$cmd" in
   logs)
     svc=${2:-}
     if [[ -d "$LOG_DIR" ]]; then
+      shopt -s nullglob
+      logs=("$LOG_DIR"/*.log)
+      shopt -u nullglob
       if [[ -n "$svc" ]]; then
         tail -n 100 -f "$LOG_DIR/$svc.log"
+      elif [[ ${#logs[@]} -gt 0 ]]; then
+        tail -n 20 "${logs[@]}"
       else
-        tail -n 20 "$LOG_DIR"/*.log
+        echo "no logs yet in $LOG_DIR"
       fi
     elif command -v journalctl >/dev/null; then
       journalctl -u aios.service -n 100 ${svc:+-g "$svc"} --no-pager

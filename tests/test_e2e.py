@@ -101,7 +101,8 @@ def stack(tmp_path_factory):
         memory_addr=f"127.0.0.1:{mem_port}",
         gateway_addr=f"127.0.0.1:{gw_port}",
     )
-    service, autonomy, scheduler, proactive, health, bus = build_orchestrator(
+    (service, autonomy, scheduler, proactive, health, bus,
+     _serving) = build_orchestrator(
         data_dir=str(tmp / "orch"),
         clients=clients,
         autonomy_config=AutonomyConfig(tick_interval=0.05),

@@ -266,7 +266,7 @@ def test_run_boots_console_with_serving_feed(tmp_path):
     every test that used build_orchestrator directly stayed green."""
     from aios_tpu.orchestrator.main import run
 
-    server, service, console, autonomy, spawner = run(
+    server, service, console, autonomy, spawner, shutdown = run(
         data_dir=str(tmp_path), grpc_address="127.0.0.1:0",
         console_port=0, spawn_agents=False, block=False,
     )
@@ -279,6 +279,7 @@ def test_run_boots_console_with_serving_feed(tmp_path):
             "models": {}
         }
     finally:
-        autonomy.stop()
-        console.stop()
-        server.stop(grace=None)
+        # stops EVERY loop run() started (scheduler/proactive/health too —
+        # a leaked health prober would spend the rest of the suite
+        # submitting service.unhealthy goals into the tmp_path db)
+        shutdown()
