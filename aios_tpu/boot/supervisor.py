@@ -208,7 +208,12 @@ class Supervisor:
                     log.error("respawn %s failed: %s",
                               entry.definition.name, exc)
 
-    def shutdown(self) -> None:
+    def shutdown(self, clean: bool = True) -> None:
+        """Tear down the service tree. clean=False reaps children after a
+        FAILED boot without writing the clean-shutdown flag — the flag is
+        how the next boot distinguishes a deliberate stop from a crash
+        (reference initd main.rs:161), so a failed run must not bless
+        itself."""
         self._stop.set()
         # reverse dependency order
         for name in reversed(topo_sort(self.services)):
@@ -224,10 +229,13 @@ class Supervisor:
                     entry.process.kill()
         if self._thread:
             self._thread.join(timeout=5)
-        flag_dir = Path(self.config.data_dir)
-        flag_dir.mkdir(parents=True, exist_ok=True)
-        (flag_dir / "clean-shutdown").write_text(str(int(time.time())))
-        log.info("clean shutdown complete")
+        if clean:
+            flag_dir = Path(self.config.data_dir)
+            flag_dir.mkdir(parents=True, exist_ok=True)
+            (flag_dir / "clean-shutdown").write_text(str(int(time.time())))
+            log.info("clean shutdown complete")
+        else:
+            log.info("service tree reaped after failed boot (not clean)")
 
 
 def main() -> int:
@@ -256,12 +264,20 @@ def main() -> int:
 
     signal.signal(signal.SIGTERM, _term)
 
-    sup.boot()
+    # boot() runs inside the try: it spawns five services sequentially and
+    # waits for readiness, a long window during which TERM/INT must still
+    # tear down the partially-booted tree instead of orphaning it
     try:
+        sup.boot()
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         sup.shutdown()
+    except Exception:
+        # a failed boot (e.g. a service missing its health gate) must also
+        # tear down whatever did spawn before the error surfaces
+        sup.shutdown(clean=False)
+        raise
     return 0
 
 

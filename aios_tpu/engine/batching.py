@@ -473,7 +473,7 @@ class ContinuousBatcher:
         # (slot freed, counters bumped) is already final
         live.out_q.put(_END)
 
-    def _evict_longest(self, replica: int = None) -> bool:
+    def _evict_longest(self, replica: Optional[int] = None) -> bool:
         """Retire the live request with the most cache rows (frees the most
         pages) so a pool-exhausted dispatch can make progress. Returns
         False when there is nothing to evict. ``replica`` restricts the

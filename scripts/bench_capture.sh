@@ -1,0 +1,35 @@
+#!/bin/bash
+# Opportunistic bench capture (VERDICT r4 item 1b): run the bench suite NOW
+# and persist the full transcript + JSON lines under docs/bench_runs/,
+# labeled as a non-driver run so the artifact trail stays falsifiable even
+# if the driver's end-of-round run hits a wedged TPU tunnel.
+#
+# Usage: scripts/bench_capture.sh [label] [extra bench.py args...]
+#   AIOS_BENCH_PROBE_SECS caps the probe window (default 600 here — an
+#   opportunistic run should fail fast; the driver's run uses the 2 h
+#   default baked into bench.py).
+set -u
+cd "$(dirname "$0")/.."
+LABEL="${1:-manual}"
+shift 2>/dev/null || true
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+OUT_DIR="docs/bench_runs"
+mkdir -p "$OUT_DIR"
+STEM="$OUT_DIR/${TS}_${LABEL}"
+export AIOS_BENCH_PROBE_SECS="${AIOS_BENCH_PROBE_SECS:-600}"
+
+{
+  echo "# bench_capture: NON-DRIVER opportunistic run"
+  echo "# label: $LABEL"
+  echo "# utc: $TS"
+  echo "# host: $(uname -a)"
+  echo "# commit: $(git rev-parse HEAD 2>/dev/null || echo unknown)"
+  echo "# dirty: $(git status --porcelain 2>/dev/null | wc -l) files"
+  echo "# cmd: python bench.py $*"
+} > "${STEM}.log"
+
+python bench.py "$@" > "${STEM}.jsonl" 2>> "${STEM}.log"
+RC=$?
+echo "# exit: $RC" >> "${STEM}.log"
+echo "captured: ${STEM}.jsonl (rc=$RC)"
+exit $RC

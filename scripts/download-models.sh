@@ -7,10 +7,15 @@
 # int8/int4/bf16 params at load (aios_tpu/engine/gguf.py) instead of
 # handing the file to llama.cpp.
 #
-# Integrity: trust-on-first-use. The first successful download of each
-# file records its sha256 into $DEST/SHA256SUMS; every later run (and
-# --verify-only) checks against that record, so a corrupted re-download
-# or bit-rotted file fails loudly instead of producing garbage decode.
+# Integrity: pinned sha256 when the spec carries one (the artifacts are
+# fixed public files with stable hashes — fill the pin field from the HF
+# repo's published checksums on a networked host; this zero-egress build
+# env cannot fetch them, and a made-up pin would reject every download).
+# Unpinned entries fall back to trust-on-first-use: the first successful
+# download records its sha256 into $DEST/SHA256SUMS and every later run
+# (and --verify-only) checks against that record, so a corrupted
+# re-download or bit-rotted file fails loudly instead of producing
+# garbage decode.
 #
 # Usage: scripts/download-models.sh [--dest DIR] [--tier tiny|tactical|all]
 #                                   [--verify-only]
@@ -33,10 +38,12 @@ mkdir -p "$DEST"
 SUMS="$DEST/SHA256SUMS"
 touch "$SUMS"
 
-# name|url|min_bytes (size sanity floor: a truncated or HTML-error
-# download is smaller than any real quantized model of the tier)
-TINY="tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|https://huggingface.co/TheBloke/TinyLlama-1.1B-Chat-v1.0-GGUF/resolve/main/tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|500000000"
-MISTRAL="mistral-7b-instruct-v0.2.Q4_K_M.gguf|https://huggingface.co/TheBloke/Mistral-7B-Instruct-v0.2-GGUF/resolve/main/mistral-7b-instruct-v0.2.Q4_K_M.gguf|4000000000"
+# name|url|min_bytes|pinned_sha256 (min_bytes: a truncated or HTML-error
+# download is smaller than any real quantized model of the tier; pin: the
+# upstream file's published sha256, or empty for TOFU — populate pins on a
+# networked host via `sha256sum` against the HF repo's checksum listing)
+TINY="tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|https://huggingface.co/TheBloke/TinyLlama-1.1B-Chat-v1.0-GGUF/resolve/main/tinyllama-1.1b-chat-v1.0.Q4_K_M.gguf|500000000|"
+MISTRAL="mistral-7b-instruct-v0.2.Q4_K_M.gguf|https://huggingface.co/TheBloke/Mistral-7B-Instruct-v0.2-GGUF/resolve/main/mistral-7b-instruct-v0.2.Q4_K_M.gguf|4000000000|"
 
 case "$TIER" in
   tiny)     MODELS=("$TINY") ;;
@@ -45,17 +52,23 @@ case "$TIER" in
   *) echo "unknown tier: $TIER" >&2; exit 2 ;;
 esac
 
-verify() {  # verify <file> against the recorded sum; 0=ok 1=bad 2=unrecorded
-  local f="$1" rec
+verify() {  # verify <file> [pin]; 0=ok 1=bad 2=unrecorded-and-unpinned
+  local f="$1" pin="${2:-}" rec
+  if [[ -n "$pin" ]]; then
+    # a pinned hash outranks the TOFU record: it came from the publisher,
+    # not from whatever the first download happened to produce
+    echo "$pin  $f" | sha256sum -c --quiet - >/dev/null 2>&1
+    return $?
+  fi
   rec=$(grep "  ${f##*/}\$" "$SUMS" | head -1 | cut -d' ' -f1) || true
   [[ -z "$rec" ]] && return 2
   echo "$rec  $f" | sha256sum -c --quiet - >/dev/null 2>&1
 }
 
-record() {
-  local f="$1" name sum
+record() {  # record <file> [known_sum] — known_sum skips re-hashing a
+  local f="$1" name sum  # multi-GB file whose hash was just verified
   name="${f##*/}"
-  sum=$(sha256sum "$f" | cut -d' ' -f1)
+  sum="${2:-$(sha256sum "$f" | cut -d' ' -f1)}"
   grep -v "  $name\$" "$SUMS" > "$SUMS.tmp" || true
   echo "$sum  $name" >> "$SUMS.tmp"
   mv "$SUMS.tmp" "$SUMS"
@@ -64,10 +77,10 @@ record() {
 
 rc=0
 for spec in "${MODELS[@]}"; do
-  IFS='|' read -r name url min_bytes <<< "$spec"
+  IFS='|' read -r name url min_bytes pin <<< "$spec"
   out="$DEST/$name"
   if [[ -f "$out" ]]; then
-    if verify "$out"; then
+    if verify "$out" "$pin"; then
       echo "[models] $name present and verified, skipping"
       continue
     elif [[ $? -eq 2 ]]; then
@@ -84,7 +97,8 @@ for spec in "${MODELS[@]}"; do
       fi
       continue
     else
-      echo "[models] ERROR: $name fails its recorded sha256" >&2
+      kind=recorded; [[ -n "$pin" ]] && kind=pinned
+      echo "[models] ERROR: $name fails its $kind sha256" >&2
       rc=1
       continue
     fi
@@ -114,8 +128,16 @@ for spec in "${MODELS[@]}"; do
     rc=1
     continue
   fi
+  if [[ -n "$pin" ]] && ! verify "$out.part" "$pin"; then
+    # a fresh download failing its publisher pin is tampering/corruption,
+    # never a state to keep or to record as trusted
+    echo "[models] ERROR: $name download fails pinned sha256; discarding" >&2
+    rm -f "$out.part"
+    rc=1
+    continue
+  fi
   mv "$out.part" "$out"
-  record "$out"
+  record "$out" "$pin"
 done
 
 echo "[models] done; $(ls "$DEST"/*.gguf 2>/dev/null | wc -l) model file(s) in $DEST"
