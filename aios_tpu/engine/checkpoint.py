@@ -109,7 +109,8 @@ def is_checkpoint_dir(path: str) -> bool:
 MODEL_META_NAME = "aios_model.json"
 
 
-def save_model_checkpoint(directory: str, cfg, params, tokenizer) -> None:
+def save_model_checkpoint(directory: str, cfg, params, tokenizer,
+                          tp: int = 1) -> None:
     import dataclasses
     import json
 
@@ -138,30 +139,41 @@ def save_model_checkpoint(directory: str, cfg, params, tokenizer) -> None:
         # on a CPU box would serve through the dequantize-in-HBM path on
         # TPU — strictly worse than int8. Engine-load quantization uses
         # target="auto", so re-check here, at the persistence boundary.
-        from ..ops.int4_matmul import kernel_supported
+        if tp > 1:
+            # tp-prepared artifacts run the kernel per device on shard-
+            # local dims — validate against those, not the global shapes
+            from .engine import _validate_prequantized_tp
 
-        # the leaf's ACTUAL stored group is K / G where s4 is [..., G, 1, N]
-        # — pick_group(K) may differ when the leaf was quantized with an
-        # explicit smaller group
-        bad = [
-            key
-            for key, v in {**params["layers"], "lm_head": params.get("lm_head")}.items()
-            if isinstance(v, dict) and "q4" in v
-            for K, N in ((v["q4"].shape[-2] * 2, v["q4"].shape[-1]),)
-            if not kernel_supported(K, N, K // v["s4"].shape[-3])
-        ]
-        if bad:
-            raise ValueError(
-                "refusing to persist int4 leaves the TPU kernel cannot "
-                f"serve ({', '.join(bad)}): re-quantize with "
-                "quantize_params(..., target='tpu') (prepare_model does "
-                "this) so ineligible dims fall back to int8"
-            )
+            _validate_prequantized_tp(params, tp)
+        else:
+            from ..ops.int4_matmul import kernel_supported
+
+            # the leaf's ACTUAL stored group is K / G where s4 is
+            # [..., G, 1, N] — pick_group(K) may differ when the leaf was
+            # quantized with an explicit smaller group
+            bad = [
+                key
+                for key, v in {**params["layers"], "lm_head": params.get("lm_head")}.items()
+                if isinstance(v, dict) and "q4" in v
+                for K, N in ((v["q4"].shape[-2] * 2, v["q4"].shape[-1]),)
+                if not kernel_supported(K, N, K // v["s4"].shape[-3])
+            ]
+            if bad:
+                raise ValueError(
+                    "refusing to persist int4 leaves the TPU kernel cannot "
+                    f"serve ({', '.join(bad)}): re-quantize with "
+                    "quantize_params(..., target='tpu') (prepare_model does "
+                    "this) so ineligible dims fall back to int8"
+                )
     meta = {
         "format": "aios-tpu-model-v1",
         "config": dataclasses.asdict(cfg),
         "tokenizer": tok_meta,
         "serving_quantized": quantized,
+        # tp degree the QUANTIZED layout was prepared for (1 = fused
+        # single-chip); informative — the engine re-validates against the
+        # actual plan at load
+        "prepared_tp": tp if quantized else 1,
     }
     tmp = os.path.join(directory, MODEL_META_NAME + ".tmp")
     with open(tmp, "w") as fh:

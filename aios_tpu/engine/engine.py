@@ -83,6 +83,77 @@ def _prequantized_mode(params) -> str:
     return "int8"
 
 
+def _resolve_stored_mode(params, requested, *, quiet_default: bool = False):
+    """The STORED serving mode of a prequantized tree wins over the
+    engine-level request; flag a mismatch rather than silently reporting
+    the wrong precision. ``quiet_default`` logs the no-request case at
+    info (benches/prepared checkpoints pass quantized trees without a
+    mode on purpose)."""
+    stored = _prequantized_mode(params)
+    if requested and requested != stored:
+        log.warning(
+            "checkpoint stores %s serving weights; requested quantize=%s "
+            "is ignored (re-run prepare_model to change the stored mode)",
+            stored, requested,
+        )
+    elif not requested and quiet_default:
+        log.info(
+            "serving prequantized %s weights (bf16 serving is unavailable "
+            "for prepared-quantized trees)", stored,
+        )
+    return stored
+
+
+def _is_fused_prequantized(params) -> bool:
+    """True for the FUSED single-chip serving layout (w_qkv/w_gateup
+    concats from quantize_params fuse=True) — it has no TP sharding rule
+    (a fused concat would interleave q/k/v columns across shards)."""
+    layers = params.get("layers", {}) if isinstance(params, dict) else {}
+    return any(k in layers for k in ("w_qkv", "w_gateup", "we_gateup"))
+
+
+# keys whose CONTRACTION dim (K) shards under tp (row-parallel); every
+# other quantized projection — and the lm_head's vocab — shards its
+# output dim N (column-parallel). Mirrors quantize_params's tp rule.
+_ROW_PARALLEL_KEYS = ("wo", "w_down")
+
+
+def _validate_prequantized_tp(params, tp: int) -> None:
+    """A prepared (unfused) quantized tree must have been quantized for
+    THIS tp degree: int4 scale groups are picked from shard-local dims, so
+    a mismatched plan would hand the per-device kernel groups it cannot
+    serve. Raise with the re-prepare recipe instead of failing inside
+    shard_map."""
+    if tp <= 1:
+        return
+    from ..ops.int4_matmul import kernel_supported
+
+    leaves = dict(params.get("layers", {}))
+    if isinstance(params.get("lm_head"), dict):
+        leaves["lm_head"] = params["lm_head"]
+    bad = []
+    for key, v in leaves.items():
+        if not (isinstance(v, dict) and "q4" in v):
+            continue
+        K, N = v["q4"].shape[-2] * 2, v["q4"].shape[-1]
+        groups = v["s4"].shape[-3]
+        group = K // groups
+        if key in _ROW_PARALLEL_KEYS:
+            ok = (K % tp == 0 and groups % tp == 0
+                  and kernel_supported(K // tp, N, group))
+        else:
+            ok = N % tp == 0 and kernel_supported(K, N // tp, group)
+        if not ok:
+            bad.append(key)
+    if bad:
+        raise ValueError(
+            f"prepared int4 checkpoint is not servable under tp={tp} "
+            f"(leaves {', '.join(bad)}): re-run scripts/prepare_model.py "
+            f"--quantize int4 --tp {tp} so shard-local eligibility and "
+            "scale groups are baked for this plan"
+        )
+
+
 def _on_accelerator(params) -> bool:
     """True if ANY param leaf already lives on a non-CPU jax device (a
     mixed tree must not round-trip device weights through the host)."""
@@ -181,15 +252,29 @@ class TPUEngine:
 
         if shardings is not None:
             if _is_prequantized(params):
-                # prepared checkpoints store the FUSED single-chip layout
-                # (w_qkv/w_gateup), which has no TP sharding rule — a fused
-                # concat would interleave q/k/v columns across shards
-                raise ValueError(
-                    "prequantized (prepared) checkpoints are single-chip "
-                    "serving artifacts; sharded plans must load the dense "
-                    "source and quantize at load time (quantize='int8')"
+                if _is_fused_prequantized(params):
+                    # the fused concat layout has no TP sharding rule — a
+                    # fused w_qkv would interleave q/k/v columns across
+                    # shards. Unfused prepared artifacts load fine below.
+                    raise ValueError(
+                        "this prepared checkpoint stores the FUSED "
+                        "single-chip layout; sharded plans need an unfused "
+                        "artifact (scripts/prepare_model.py --quantize "
+                        f"{quantize or 'int8'} --tp {shardings.tp}) or the "
+                        "dense source with quantize at load time"
+                    )
+                # unfused prepared artifact (prepare_model --tp N): leaves
+                # already match quantize_params(fuse=False, tp=...) — shard
+                # straight to the mesh, no load-time quantization pass (the
+                # BASELINE config-4 boot path: no dense-weight transient,
+                # no per-boot quantization)
+                self.quant_mode = quantize = _resolve_stored_mode(
+                    params, quantize
                 )
-            if quantize:
+                self.quantized = True
+                _validate_prequantized_tp(params, shardings.tp)
+                self.params = shardings.put_params(params)
+            elif quantize:
                 # unfused layout: each projection's output dim shards on tp,
                 # scales follow (sharding.py quantized-leaf rules); the
                 # int8 x bf16 dot_generals partition like their dense
@@ -209,24 +294,10 @@ class TPUEngine:
             if _is_prequantized(params):
                 # prepared serving checkpoint (scripts/prepare_model.py
                 # --quantize): the leaves are already {"q","s"}/{"q4","s4"}
-                # — restore straight to device, nothing to quantize. The
-                # STORED mode wins; flag a mismatched request rather than
-                # silently reporting the wrong precision.
-                stored = _prequantized_mode(params)
-                if quantize and quantize != stored:
-                    log.warning(
-                        "checkpoint stores %s serving weights; requested "
-                        "quantize=%s is ignored (re-run prepare_model to "
-                        "change the stored mode)", stored, quantize,
-                    )
-                elif not quantize:
-                    # info, not warning: benches/prepared checkpoints pass
-                    # quantized trees without a mode on purpose
-                    log.info(
-                        "serving prequantized %s weights (bf16 serving is "
-                        "unavailable for prepared-quantized trees)", stored,
-                    )
-                self.quant_mode = quantize = stored
+                # — restore straight to device, nothing to quantize.
+                self.quant_mode = quantize = _resolve_stored_mode(
+                    params, quantize, quiet_default=True
+                )
                 self.quantized = True
                 self.params = jax.tree.map(_to_default_device, params)
             elif quantize and not _on_accelerator(params):

@@ -25,9 +25,26 @@ CONSOLE_HP=${CONSOLE#*://}; CONSOLE_HP=${CONSOLE_HP%%/*}
 CONSOLE_HOST=${CONSOLE_HP%%:*}
 CONSOLE_PORT=${CONSOLE_HP##*:}; [[ "$CONSOLE_PORT" == "$CONSOLE_HOST" ]] && CONSOLE_PORT=80
 
+# service addresses honor the same AIOS_*_ADDR env overrides the service
+# clients use (host:port), so aiosctl can point at a non-default stack
+# (e.g. the e2e test stack on ephemeral ports)
+addr_port() { local a="${1:-}"; echo "${a##*:}"; }
+addr_host() { local a="${1:-}" h; h="${a%%:*}"; echo "${h:-127.0.0.1}"; }
 declare -A PORTS=(
-  [orchestrator]=50051 [tools]=50052 [memory]=50053
-  [gateway]=50054 [runtime]=50055 [console]=$CONSOLE_PORT
+  [orchestrator]=$(addr_port "${AIOS_ORCHESTRATOR_ADDR:-:50051}")
+  [tools]=$(addr_port "${AIOS_TOOLS_ADDR:-:50052}")
+  [memory]=$(addr_port "${AIOS_MEMORY_ADDR:-:50053}")
+  [gateway]=$(addr_port "${AIOS_GATEWAY_ADDR:-:50054}")
+  [runtime]=$(addr_port "${AIOS_RUNTIME_ADDR:-:50055}")
+  [console]=$CONSOLE_PORT
+)
+declare -A HOSTS=(
+  [orchestrator]=$(addr_host "${AIOS_ORCHESTRATOR_ADDR:-}")
+  [tools]=$(addr_host "${AIOS_TOOLS_ADDR:-}")
+  [memory]=$(addr_host "${AIOS_MEMORY_ADDR:-}")
+  [gateway]=$(addr_host "${AIOS_GATEWAY_ADDR:-}")
+  [runtime]=$(addr_host "${AIOS_RUNTIME_ADDR:-}")
+  [console]=$CONSOLE_HOST
 )
 
 probe() {  # probe <host> <port> — the subshell opens and closes the socket
@@ -40,8 +57,7 @@ case "$cmd" in
     rc=0
     for name in orchestrator tools memory gateway runtime console; do
       port=${PORTS[$name]}
-      host=127.0.0.1
-      [[ "$name" == console ]] && host=$CONSOLE_HOST
+      host=${HOSTS[$name]}
       if probe "$host" "$port"; then
         echo "$name :$port up"
       else

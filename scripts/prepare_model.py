@@ -19,6 +19,13 @@ Options:
                        LoadModel then restores {"q","s"}/{"q4","s4"} leaves
                        straight to device with no quantization pass (and no
                        dense-weights HBM transient) on the serving path
+  --tp N               prepare the quantized artifact for an N-way
+                       tensor-parallel plan: stores the UNFUSED per-
+                       projection layout with int4 eligibility and scale
+                       groups computed for the shard-local dims, so a TP
+                       deployment (BASELINE config 4) restores straight to
+                       the mesh instead of re-quantizing dense weights at
+                       every boot. Default 1 = fused single-chip layout.
   --context N          override max_context recorded in the config
   --verify             run a short greedy generation after writing
 """
@@ -39,9 +46,16 @@ def main() -> int:
     ap.add_argument("out", help="output checkpoint directory")
     ap.add_argument("--dtype", default="bf16", choices=("bf16", "f32"))
     ap.add_argument("--quantize", default="", choices=("", "int8", "int4"))
+    ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--context", type=int, default=0)
     ap.add_argument("--verify", action="store_true")
     args = ap.parse_args()
+    if args.tp > 1 and not args.quantize:
+        # dense checkpoints already load under any plan (the engine shards
+        # and optionally quantizes at load); --tp only changes the stored
+        # QUANTIZED layout
+        ap.error("--tp requires --quantize (dense artifacts are plan-"
+                 "agnostic already)")
 
     import jax.numpy as jnp
 
@@ -75,15 +89,19 @@ def main() -> int:
 
         t0 = time.time()
         # target="tpu": strict kernel eligibility, so preparing on a CPU
-        # build box never bakes in int4 leaves a TPU can't kernel-serve
+        # build box never bakes in int4 leaves a TPU can't kernel-serve.
+        # tp>1 keeps the projections unfused with shard-local eligibility
+        # (the fused concat has no TP sharding rule).
         params = model_mod.quantize_params(
-            params, mode=args.quantize, target="tpu"
+            params, mode=args.quantize, target="tpu",
+            fuse=args.tp == 1, tp=args.tp,
         )
-        print(f"quantized to {args.quantize} serving layout "
+        layout = "fused single-chip" if args.tp == 1 else f"unfused tp={args.tp}"
+        print(f"quantized to {args.quantize} serving layout ({layout}) "
               f"({time.time() - t0:.1f}s)", file=sys.stderr)
 
     t0 = time.time()
-    ckpt.save_model_checkpoint(args.out, cfg, params, tokenizer)
+    ckpt.save_model_checkpoint(args.out, cfg, params, tokenizer, tp=args.tp)
     print(f"checkpoint written to {args.out} ({time.time() - t0:.1f}s)",
           file=sys.stderr)
 

@@ -179,9 +179,10 @@ def test_prepared_quantized_checkpoint_serves_without_requantize(tmp_path):
     assert got == want
 
 
-def test_prequantized_checkpoint_refused_under_sharding_plan():
-    """Prepared quantized checkpoints are single-chip artifacts (fused
-    layout has no TP rule); a sharded engine must refuse them clearly."""
+def test_fused_prequantized_checkpoint_refused_under_sharding_plan():
+    """FUSED prepared checkpoints (the single-chip layout) have no TP
+    sharding rule; a sharded engine must refuse them with the re-prepare
+    recipe (unfused artifacts load fine — tests below)."""
     import jax
     import jax.numpy as jnp
     import pytest
@@ -194,6 +195,112 @@ def test_prequantized_checkpoint_refused_under_sharding_plan():
     params = M.init_params(TINY_TEST, jax.random.PRNGKey(32), dtype=jnp.float32)
     qp = M.quantize_params(params, mode="int8")
     plan = ShardingPlan(build_mesh(tp=2, n_devices=2))
-    with pytest.raises(ValueError, match="single-chip"):
+    with pytest.raises(ValueError, match="FUSED"):
         TPUEngine(TINY_TEST, qp, num_slots=2, max_context=64,
                   shardings=plan, quantize="int8")
+
+
+def test_tp_prepared_checkpoint_loads_under_plan(tmp_path):
+    """prepare_model --quantize int8 --tp 2 equivalent: the unfused
+    artifact restores straight to the mesh and decodes token-identically
+    to quantizing the dense source at load time (VERDICT r4 item 6 — the
+    BASELINE config-4 boot path without the per-boot quantization pass)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import checkpoint as ckpt
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.engine.tokenizer import ByteTokenizer
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(33), dtype=jnp.float32)
+    qp = M.quantize_params(params, mode="int8", fuse=False, tp=2)
+    out_dir = tmp_path / "prepared-int8-tp2"
+    ckpt.save_model_checkpoint(str(out_dir), TINY_TEST, qp, ByteTokenizer(),
+                               tp=2)
+
+    cfg2, params2, _ = ckpt.load_model_checkpoint(str(out_dir))
+    assert "q" in params2["layers"]["wq"]  # unfused quantized leaves
+    import json as _json
+
+    meta = _json.loads((out_dir / "aios_model.json").read_text())
+    assert meta["prepared_tp"] == 2
+
+    plan = ShardingPlan(build_mesh(tp=2, n_devices=2))
+    eng = TPUEngine(cfg2, params2, num_slots=2, max_context=64,
+                    cache_dtype=jnp.float32, shardings=plan)
+    assert eng.quant_mode == "int8"
+    ref = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                    cache_dtype=jnp.float32, shardings=plan, quantize="int8")
+    prompt = [1, 5, 9, 2]
+    got = eng.generate(prompt, max_new_tokens=8, temperature=0.0)
+    want = ref.generate(prompt, max_new_tokens=8, temperature=0.0)
+    assert got == want
+
+
+def test_tp_prepared_int4_checkpoint_loads_under_plan(tmp_path):
+    """int4 tp-prepared artifact on a kernel-aligned geometry: shard-local
+    eligibility baked at prepare time, restored under the matching plan,
+    token-identical to load-time int4 quantization; a mismatched plan is
+    refused with the re-prepare recipe."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from aios_tpu.engine import checkpoint as ckpt
+    from aios_tpu.engine import model as M
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.engine.tokenizer import ByteTokenizer
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    # dims chosen so the int4 kernel rule holds on tp=2 SHARDS for the
+    # column projections (N/2 % 128 == 0, group 128 | K) while wk/wv
+    # (kv_dim 128 -> shard N 64) fall back to int8 — a realistic mixed tree
+    cfg = TINY_TEST.scaled(
+        name="tiny-int4-tp", vocab_size=512, hidden_size=256,
+        intermediate_size=512, num_heads=4, num_kv_heads=2, head_dim=64,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(34), dtype=jnp.float32)
+    qp = M.quantize_params(params, mode="int4", fuse=False, tp=2,
+                           target="tpu")
+    assert "q4" in qp["layers"]["wq"]
+    assert "q" in qp["layers"]["wk"]  # shard N=64 not kernel-alignable
+    out_dir = tmp_path / "prepared-int4-tp2"
+    ckpt.save_model_checkpoint(str(out_dir), cfg, qp, ByteTokenizer(), tp=2)
+
+    cfg2, params2, _ = ckpt.load_model_checkpoint(str(out_dir))
+    # the disk round-trip is bit-exact leaf by leaf (restore IS the
+    # quantized tree — no re-quantization happens at load)
+    import numpy as np
+
+    flat_a = jax.tree.leaves(qp)
+    flat_b = jax.tree.leaves(params2)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    plan = ShardingPlan(build_mesh(tp=2, n_devices=2))
+    eng = TPUEngine(cfg2, params2, num_slots=2, max_context=64,
+                    cache_dtype=jnp.float32, shardings=plan)
+    assert eng.quant_mode == "int4"
+    # identical decode to serving the same prepared tree without the disk
+    # hop. (Load-time quantization of the dense source only matches
+    # exactly when both sides use the same int4 eligibility rule — on a
+    # TPU backend both run the kernel rule; this CPU test's load-time path
+    # is storage-eligible (target="auto"), so the dense-source comparison
+    # lives in the int8 test above where no eligibility rule exists.)
+    ref = TPUEngine(cfg, qp, num_slots=2, max_context=64,
+                    cache_dtype=jnp.float32, shardings=plan)
+    prompt = [1, 5, 9, 2]
+    got = eng.generate(prompt, max_new_tokens=8, temperature=0.0)
+    want = ref.generate(prompt, max_new_tokens=8, temperature=0.0)
+    assert got == want
+
+    # a plan the groups weren't baked for must be refused up front
+    plan4 = ShardingPlan(build_mesh(tp=4, n_devices=4))
+    with pytest.raises(ValueError, match="re-run scripts/prepare_model"):
+        TPUEngine(cfg2, params2, num_slots=2, max_context=64,
+                  cache_dtype=jnp.float32, shardings=plan4)
