@@ -51,6 +51,14 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             "speculative": False,    # n-gram speculative decode
             "json_mode": "",         # "force" = reference json_object parity
             "guided_toolcalls": False,  # schema-guided reasoning replies
+            # multi-chip serving mesh, e.g. "tp=4" (BASELINE config 4:
+            # Mistral-7B TP over a v5e-4) or "dp=2,sp=2,tp=2"; "" = one
+            # chip. With sp > 1, models whose KV cache exceeds the
+            # per-chip HBM budget automatically shard their context axis
+            # over sp (the long-context degradation path — paging is
+            # dropped for those models since pages cannot split across
+            # sp shards).
+            "mesh": "",
         },
         "api": {
             "claude_model": "claude-sonnet-4-20250514",
@@ -172,6 +180,8 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
             rows = 0
         if rows > 0:
             put("AIOS_TPU_PAGED_KV", str(rows))
+    if m.get("mesh"):
+        put("AIOS_TPU_MESH", str(m["mesh"]))
     if m.get("speculative"):
         put("AIOS_TPU_SPECULATIVE", "1")
     if m.get("json_mode"):

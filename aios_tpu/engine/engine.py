@@ -256,12 +256,14 @@ class TPUEngine:
                     # the fused concat layout has no TP sharding rule — a
                     # fused w_qkv would interleave q/k/v columns across
                     # shards. Unfused prepared artifacts load fine below.
+                    # The recipe names the checkpoint's STORED mode so the
+                    # re-prepare doesn't silently change precision.
                     raise ValueError(
                         "this prepared checkpoint stores the FUSED "
                         "single-chip layout; sharded plans need an unfused "
                         "artifact (scripts/prepare_model.py --quantize "
-                        f"{quantize or 'int8'} --tp {shardings.tp}) or the "
-                        "dense source with quantize at load time"
+                        f"{_prequantized_mode(params)} --tp {shardings.tp}) "
+                        "or the dense source with quantize at load time"
                     )
                 # unfused prepared artifact (prepare_model --tp N): leaves
                 # already match quantize_params(fuse=False, tp=...) — shard
@@ -415,14 +417,13 @@ class TPUEngine:
         self._paged_scatter = None
         self.pool_replicas = 1
         if self.paged:
-            if shardings is not None and shardings.sp > 1:
-                # sp shards the CONTEXT axis; a page holds contiguous rows
-                # of one slot, so pages cannot split across sp shards —
-                # use seq_sharded_cache for sp-sharded long-context serving
-                raise ValueError(
-                    "paged KV cache composes with dp/tp only (sp=1): pages "
-                    "hold contiguous context rows and cannot shard over sp"
-                )
+            # sp in the mesh: the pool (like any non-seq-sharded cache)
+            # REPLICATES over the sp axis — its shard_map specs name only
+            # dp/tp, so each sp slice runs the identical pool program. A
+            # context that must SHARD over sp (exceeding per-chip HBM)
+            # uses seq_sharded_cache instead — pages hold contiguous rows
+            # of one slot and cannot split across sp shards; the model
+            # manager's HBM-budget check picks between the two per model.
             if page_size < 1 or page_size & (page_size - 1):
                 # chunked admission relies on power-of-two chunk/page sizes
                 # never straddling (model.prefill_chunk_paged)

@@ -78,6 +78,9 @@ class ManagedModel:
     last_used: int = 0
     request_count: int = 0
     error: str = ""
+    # estimated per-chip HBM this model pins (weights + KV); co-resident
+    # loads subtract it from the auto-degradation budget
+    hbm_chip_bytes: float = 0.0
     lock: threading.Lock = field(default_factory=threading.Lock)
 
     def touch(self) -> None:
@@ -96,6 +99,63 @@ def _context_for_file_size(n_bytes: int) -> int:
     return 2048
 
 
+def _plan_from_env():
+    """Build a sharding plan from AIOS_TPU_MESH ("dp=2,sp=2,tp=2"; missing
+    axes default to 1) — how a multi-chip deployment's boot config selects
+    its mesh (the [models] mesh knob -> serving_env()). Returns None when
+    unset, malformed, or when the visible devices can't fill the mesh (a
+    bad tuning knob must not take down boot — the lenient pattern of the
+    sibling env parsers)."""
+    spec = os.environ.get("AIOS_TPU_MESH", "").strip().lower()
+    if not spec:
+        return None
+    axes = {"dp": 1, "sp": 1, "ep": 1, "tp": 1}
+    try:
+        for part in spec.split(","):
+            k, _, v = part.strip().partition("=")
+            if k not in axes:
+                raise ValueError(f"unknown mesh axis {k!r}")
+            axes[k] = int(v)
+            if axes[k] < 1:
+                raise ValueError(f"axis {k} must be >= 1")
+    except ValueError as exc:
+        log.warning("AIOS_TPU_MESH=%r ignored (%s); serving single-chip",
+                    spec, exc)
+        return None
+    n = axes["dp"] * axes["sp"] * axes["ep"] * axes["tp"]
+    if n == 1:
+        return None
+    from ..parallel.sharding import ShardingPlan, build_mesh
+
+    if len(jax.devices()) < n:
+        log.warning(
+            "AIOS_TPU_MESH=%r needs %d devices, found %d; serving "
+            "single-chip", spec, n, len(jax.devices()),
+        )
+        return None
+    return ShardingPlan(build_mesh(
+        n, dp=axes["dp"], sp=axes["sp"], ep=axes["ep"], tp=axes["tp"]
+    ))
+
+
+def _chip_hbm_bytes() -> float:
+    """Per-device HBM capacity: AIOS_TPU_HBM_GB override, else the
+    backend's reported limit, else the v5e default (16 GB)."""
+    env = os.environ.get("AIOS_TPU_HBM_GB", "")
+    if env:
+        try:
+            return float(env) * 1e9
+        except ValueError:
+            log.warning("AIOS_TPU_HBM_GB=%r ignored (not a number)", env)
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return float(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 - stats are best-effort off-TPU
+        pass
+    return 16e9
+
+
 class ModelManager:
     """Registry of co-resident TPU models sharing the chip's HBM."""
 
@@ -108,6 +168,8 @@ class ModelManager:
     ) -> None:
         self.models: Dict[str, ManagedModel] = {}
         self.num_slots = num_slots
+        if sharding_plan is None:
+            sharding_plan = _plan_from_env()
         self.plan = sharding_plan
         self.warm_compile = warm_compile
         # int8 serving weights: the default on single-chip TPU (the reference
@@ -198,13 +260,11 @@ class ModelManager:
                     "AIOS_TPU_PAGED_KV=%r ignored (expected a positive "
                     "row count, 'auto', or 0/off)", paged_env,
                 )
-        if self.paged_pool_rows is not None and sharding_plan is not None \
-                and sharding_plan.sp > 1:
-            log.warning(
-                "AIOS_TPU_PAGED_KV ignored: pages cannot shard over sp "
-                "(use AIOS_TPU_SEQ_SHARD_KV for sp-sharded contexts)"
-            )
-            self.paged_pool_rows = None
+        # sp > 1 in the mesh no longer disables paging wholesale: the pool
+        # replicates over sp, and the per-model HBM-budget check at load
+        # time degrades only the models that actually need their context
+        # sharded (seq_sharded_cache) — see the auto-degrade branch in
+        # _build_engine's config resolution below.
         # AIOS_TPU_SPECULATIVE=1 turns on n-gram speculative decode
         # dispatches (engine/spec.py): greedy agent requests — tool-call
         # JSON, quoted context — emit several tokens per verify round with
@@ -219,6 +279,20 @@ class ModelManager:
             "AIOS_TPU_SEQ_SHARD_KV", ""
         ).lower() in ("1", "true", "on")
         self._lock = threading.Lock()
+
+    def _kv_bytes_per_chip(self, cfg, ctx, cache_dtype, kw) -> float:
+        """Estimated per-chip HBM the KV cache will pin under the current
+        plan: slots shard over dp and kv heads over tp; the paged pool's
+        rows split across dp replicas. sp does NOT divide the estimate
+        unless the cache is seq-sharded — which is exactly what the
+        auto-degrade check decides."""
+        item = 1 if cache_dtype == jnp.int8 else 2
+        row = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * item
+        dp = tp = 1
+        if self.plan is not None:
+            dp, tp = self.plan.dp, self.plan.tp
+        rows = kw.get("paged_pool_rows") or self.num_slots * ctx
+        return row * rows / (dp * tp)
 
     # -- loading ------------------------------------------------------------
 
@@ -286,19 +360,69 @@ class ModelManager:
                         "AIOS_TPU_SEQ_SHARD_KV ignored for %s: needs "
                         "sp > 1 dividing context %d", name, ctx,
                     )
+            # Per-chip HBM footprint estimate (recorded on the managed
+            # model so later co-resident loads can budget against it).
+            # Prepared trees are already in serving precision; dense trees
+            # shrink when the engine quantizes them later.
+            from ..engine.engine import _is_prequantized
+
+            factor = 1.0 if _is_prequantized(params) else {
+                "int8": 0.5, "int4": 0.25,
+            }.get(self.quantize, 1.0)
+            tp = self.plan.tp if self.plan is not None else 1
+            weight_chip = model_mod.serving_weight_bytes(params) * factor / tp
+            kv_chip = self._kv_bytes_per_chip(cfg, ctx, cache_dtype, kw)
+            hbm_estimate = weight_chip + kv_chip
+            if (
+                self.plan is not None
+                and self.plan.sp > 1
+                and not kw.get("seq_sharded_cache")
+            ):
+                # Long-context auto-degradation (the graceful path a boot
+                # config with sp > 1 selects without any extra knob): when
+                # this model's KV cache cannot fit the per-chip HBM budget
+                # even paged, shard the context axis over sp instead —
+                # giving up paging/prefix sharing (pages hold contiguous
+                # rows and cannot split across sp shards) but keeping the
+                # model servable. Estimates carry a 15% headroom;
+                # co-resident models' footprints count against the budget.
+                resident = sum(
+                    mm.hbm_chip_bytes for mm in self.models.values()
+                    if mm.name != name
+                )
+                budget = _chip_hbm_bytes() * 0.85 - weight_chip - resident
+                if kv_chip > max(budget, 0.0):
+                    if ctx % self.plan.sp:
+                        log.warning(
+                            "%s: KV cache needs ~%.1f GB/chip (budget "
+                            "~%.1f GB) but context %d does not divide by "
+                            "sp=%d, so the seq-sharded degradation is "
+                            "unavailable — loading anyway and HBM may "
+                            "overflow; pick a context divisible by sp",
+                            name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
+                            ctx, self.plan.sp,
+                        )
+                    else:
+                        log.warning(
+                            "%s: KV cache needs ~%.1f GB/chip (budget "
+                            "~%.1f GB after weights + co-resident "
+                            "models); sharding the context axis over "
+                            "sp=%d and dropping the paged pool",
+                            name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
+                            self.plan.sp,
+                        )
+                        kw = dict(seq_sharded_cache=True)
+                        hbm_estimate = (
+                            weight_chip + kv_chip / self.plan.sp
+                        )
             quantize = self.quantize
             if not self.quantize_explicit:
-                from ..engine.engine import _is_prequantized
-
                 if quantize and _is_prequantized(params):
                     # auto-derived default meets a prepared checkpoint:
                     # serve the stored mode without a mismatch warning
                     quantize = None
             elif not quantize:
-                from ..engine.engine import (
-                    _is_prequantized,
-                    _prequantized_mode,
-                )
+                from ..engine.engine import _prequantized_mode
 
                 if _is_prequantized(params):
                     # the engine cannot distinguish explicit bf16 from
@@ -343,6 +467,7 @@ class ModelManager:
                 tokenizer=tokenizer,
                 state=STATE_READY,
                 loaded_at=int(time.time()),
+                hbm_chip_bytes=hbm_estimate,
             )
             with self._lock:
                 self.models[name] = managed

@@ -142,6 +142,7 @@ def test_serving_env_from_boot_config(tmp_path):
         "json_mode = \"force\"\n"
         "guided_toolcalls = true\n"
         "quantize = \"1\"\n"
+        "mesh = \"dp=2,tp=2\"\n"
     )
     cfg = load_config(str(cfg_file))
     env = serving_env(cfg)
@@ -152,6 +153,7 @@ def test_serving_env_from_boot_config(tmp_path):
         "AIOS_TPU_SPECULATIVE": "1",
         "AIOS_TPU_JSON_MODE": "force",
         "AIOS_TPU_GUIDED_TOOLCALLS": "1",
+        "AIOS_TPU_MESH": "dp=2,tp=2",
     }
     defs = default_services(cfg)
     for d in defs.values():

@@ -686,17 +686,36 @@ def test_paged_pool_int8_under_tp(params, cpu_devices):
         ref.close()
 
 
-def test_paged_pool_refuses_sp_sharding(params, cpu_devices):
-    """sp shards the context axis; pages hold contiguous context rows, so
-    the pool refuses sp>1 (dp>1 replicates the pool instead — covered by
-    test_parallel.py::test_paged_pool_dp_replicated_decode...)."""
+def test_paged_pool_composes_with_sp_mesh(params, cpu_devices):
+    """An sp>1 MESH no longer disables paging: the pool's shard_map specs
+    name only dp/tp, so it replicates over the sp axis and decode matches
+    the sp-free paged engine. (A context that must SHARD over sp uses
+    seq_sharded_cache instead — the model manager's HBM-budget check
+    picks per model; see test_runtime_service.py.)"""
     from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
 
     plan = ShardingPlan(build_mesh(4, sp=2, tp=2))
-    with pytest.raises(ValueError, match="sp=1"):
+    eng = TPUEngine(TINY_TEST, params, num_slots=4, max_context=256,
+                    cache_dtype=jnp.float32, paged_pool_rows=256,
+                    page_size=32, shardings=plan)
+    ref_plan = ShardingPlan(build_mesh(2, tp=2))
+    ref = TPUEngine(TINY_TEST, params, num_slots=4, max_context=256,
+                    cache_dtype=jnp.float32, paged_pool_rows=256,
+                    page_size=32, shardings=ref_plan)
+    for e in (eng, ref):
+        e.prefill(0, [1, 2, 3, 4], temperature=0.0)
+    got = eng.step(2)
+    want = ref.step(2)
+    assert got.tolist() == want.tolist(), (
+        "paged decode over an sp mesh diverged from the sp-free pool"
+    )
+
+    # seq-sharded + paged on the SAME engine stays impossible (pages hold
+    # contiguous rows of one slot and cannot split across sp shards)
+    with pytest.raises(ValueError, match="exclusive"):
         TPUEngine(TINY_TEST, params, num_slots=4, max_context=256,
                   cache_dtype=jnp.float32, paged_pool_rows=256,
-                  page_size=32, shardings=plan)
+                  page_size=32, shardings=plan, seq_sharded_cache=True)
 
 
 # ---------------------------------------------------------------------------

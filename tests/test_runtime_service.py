@@ -168,3 +168,95 @@ def test_paged_auto_sizes_pool_from_slots_and_context(monkeypatch):
         assert eng.allocator.num_pages == 1 + rows // 128
     finally:
         mgr.unload_model("tiny")
+
+
+def test_mesh_env_builds_sharding_plan(monkeypatch):
+    """AIOS_TPU_MESH (the [models] mesh boot knob) gives the production
+    runtime a multi-chip plan; malformed or oversized specs degrade to
+    single-chip serving instead of failing boot."""
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    monkeypatch.setenv("AIOS_TPU_MESH", "dp=2,tp=2")
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    assert mgr.plan is not None
+    assert mgr.plan.dp == 2 and mgr.plan.tp == 2 and mgr.plan.sp == 1
+    m = mgr.load_model("tiny", "synthetic://tiny-test", context_length=128)
+    try:
+        assert m.state == "ready"
+        assert m.engine.step(2).shape[1] == 2
+    finally:
+        mgr.unload_model("tiny")
+
+    monkeypatch.setenv("AIOS_TPU_MESH", "tp=999")
+    assert ModelManager(num_slots=2, warm_compile=False).plan is None
+    monkeypatch.setenv("AIOS_TPU_MESH", "bogus")
+    assert ModelManager(num_slots=2, warm_compile=False).plan is None
+    monkeypatch.setenv("AIOS_TPU_MESH", "tp=1")
+    assert ModelManager(num_slots=2, warm_compile=False).plan is None
+
+
+def test_long_context_auto_degrades_to_seq_sharded(monkeypatch):
+    """With sp > 1 in the mesh, a model whose KV cache exceeds the
+    per-chip HBM budget automatically gives up the paged pool and shards
+    its context axis over sp (VERDICT r4 item 7's graceful path) — while a
+    model that fits keeps paging."""
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    monkeypatch.setenv("AIOS_TPU_MESH", "sp=2")
+    monkeypatch.setenv("AIOS_TPU_PAGED_KV", "auto")
+
+    # tiny budget: even the tiny-test cache overflows -> seq-sharded
+    monkeypatch.setenv("AIOS_TPU_HBM_GB", "0.000001")
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    assert mgr.plan is not None and mgr.plan.sp == 2
+    m = mgr.load_model("tiny", "synthetic://tiny-test", context_length=128)
+    try:
+        assert m.engine.seq_sharded and not m.engine.paged
+        assert m.state == "ready"
+        assert m.engine.step(2).shape[1] == 2
+    finally:
+        mgr.unload_model("tiny")
+
+    # ample budget: paging is kept — the pool replicates over the unused
+    # sp axis and decode still executes
+    monkeypatch.setenv("AIOS_TPU_HBM_GB", "16")
+    mgr2 = ModelManager(num_slots=2, warm_compile=False)
+    m2 = mgr2.load_model("tiny", "synthetic://tiny-test", context_length=128)
+    try:
+        assert m2.engine.paged and not m2.engine.seq_sharded
+        assert m2.state == "ready"
+        assert m2.engine.step(2).shape[1] == 2
+    finally:
+        mgr2.unload_model("tiny")
+
+
+def test_hbm_budget_counts_co_resident_models(monkeypatch):
+    """The auto-degrade budget charges models already resident in the
+    manager: with a budget sized for ~one model, the first keeps its paged
+    pool and the second (identical) model degrades to the seq-sharded
+    cache instead of overflowing HBM."""
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    monkeypatch.setenv("AIOS_TPU_MESH", "sp=2")
+    monkeypatch.setenv("AIOS_TPU_PAGED_KV", "auto")
+    monkeypatch.setenv("AIOS_TPU_HBM_GB", "16")  # ample: measure footprint
+    probe = ModelManager(num_slots=2, warm_compile=False)
+    ma = probe.load_model("a", "synthetic://tiny-test", context_length=128)
+    footprint = ma.hbm_chip_bytes
+    assert footprint > 0
+    probe.unload_model("a")
+
+    # budget ~= 2x one model's footprint minus a sliver: model A fits
+    # paged; model B's KV no longer does once A is counted
+    monkeypatch.setenv(
+        "AIOS_TPU_HBM_GB", str((2 * footprint - 1) / 0.85 / 1e9)
+    )
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    a = mgr.load_model("a", "synthetic://tiny-test", context_length=128)
+    b = mgr.load_model("b", "synthetic://tiny-test", context_length=128)
+    try:
+        assert a.engine.paged and not a.engine.seq_sharded
+        assert b.engine.seq_sharded and not b.engine.paged
+    finally:
+        mgr.unload_model("a")
+        mgr.unload_model("b")
