@@ -348,12 +348,17 @@ class ModelManager:
                         128 if cache_dtype == jnp.int8 else 16,
                     )
             if self.seq_shard_kv:
-                if kw:
-                    log.warning(
-                        "AIOS_TPU_SEQ_SHARD_KV ignored for %s: the paged "
-                        "KV pool is active and they are exclusive", name,
-                    )
-                elif self.plan.sp > 1 and ctx % self.plan.sp == 0:
+                if self.plan is not None and self.plan.sp > 1 \
+                        and ctx % self.plan.sp == 0:
+                    if kw:
+                        # the operator explicitly asked for the sp-sharded
+                        # cache; it and the paged pool are exclusive, so
+                        # the explicit force wins over the paging default
+                        log.info(
+                            "%s: AIOS_TPU_SEQ_SHARD_KV drops the paged "
+                            "pool (exclusive with the sp-sharded cache)",
+                            name,
+                        )
                     kw = dict(seq_sharded_cache=True)
                 else:
                     log.warning(
@@ -373,11 +378,7 @@ class ModelManager:
             weight_chip = model_mod.serving_weight_bytes(params) * factor / tp
             kv_chip = self._kv_bytes_per_chip(cfg, ctx, cache_dtype, kw)
             hbm_estimate = weight_chip + kv_chip
-            if (
-                self.plan is not None
-                and self.plan.sp > 1
-                and not kw.get("seq_sharded_cache")
-            ):
+            if not kw.get("seq_sharded_cache"):
                 # Long-context auto-degradation (the graceful path a boot
                 # config with sp > 1 selects without any extra knob): when
                 # this model's KV cache cannot fit the per-chip HBM budget
@@ -386,34 +387,35 @@ class ModelManager:
                 # rows and cannot split across sp shards) but keeping the
                 # model servable. Estimates carry a 15% headroom;
                 # co-resident models' footprints count against the budget.
+                # Without a usable sp axis the shortfall is still WARNED so
+                # the first symptom isn't a serve-time OOM.
                 resident = sum(
                     mm.hbm_chip_bytes for mm in self.models.values()
                     if mm.name != name
                 )
                 budget = _chip_hbm_bytes() * 0.85 - weight_chip - resident
+                sp = self.plan.sp if self.plan is not None else 1
                 if kv_chip > max(budget, 0.0):
-                    if ctx % self.plan.sp:
-                        log.warning(
-                            "%s: KV cache needs ~%.1f GB/chip (budget "
-                            "~%.1f GB) but context %d does not divide by "
-                            "sp=%d, so the seq-sharded degradation is "
-                            "unavailable — loading anyway and HBM may "
-                            "overflow; pick a context divisible by sp",
-                            name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
-                            ctx, self.plan.sp,
-                        )
-                    else:
+                    if sp > 1 and ctx % sp == 0:
                         log.warning(
                             "%s: KV cache needs ~%.1f GB/chip (budget "
                             "~%.1f GB after weights + co-resident "
                             "models); sharding the context axis over "
                             "sp=%d and dropping the paged pool",
                             name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
-                            self.plan.sp,
+                            sp,
                         )
                         kw = dict(seq_sharded_cache=True)
-                        hbm_estimate = (
-                            weight_chip + kv_chip / self.plan.sp
+                        hbm_estimate = weight_chip + kv_chip / sp
+                    else:
+                        log.warning(
+                            "%s: KV cache needs ~%.1f GB/chip (budget "
+                            "~%.1f GB) and the seq-sharded degradation "
+                            "is unavailable (%s) — loading anyway and "
+                            "HBM may overflow",
+                            name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
+                            f"context {ctx} does not divide by sp={sp}"
+                            if sp > 1 else "no sp axis in the mesh",
                         )
             quantize = self.quantize
             if not self.quantize_explicit:

@@ -17,6 +17,7 @@ file exists, and run the full proof the moment one does:
 """
 
 import os
+import struct
 from pathlib import Path
 
 import pytest
@@ -29,37 +30,51 @@ _SEARCH_DIRS = [
 ]
 
 
+def _looks_real(path: Path) -> bool:
+    """Signature check, not a size floor (VERDICT r4 item 2): a REAL
+    llama.cpp artifact — even a tiny one vendored for CI — carries a full
+    production vocabulary, which no in-repo synthetic/spec fixture does
+    (they top out at a few hundred tokens). A reader/import regression
+    must NOT read as "no real file" (that would silently skip the proof),
+    so only parse-of-garbage errors are caught."""
+    from aios_tpu.engine.gguf import GGUFFile
+
+    try:
+        g = GGUFFile(path)
+    except (ValueError, OSError, KeyError, EOFError, struct.error):
+        return False  # not a GGUF file at all (e.g. a corrupt download)
+    tokens = g.metadata.get("tokenizer.ggml.tokens") or []
+    return bool(g.metadata.get("general.architecture")) and len(tokens) >= 16000
+
+
 def _real_files():
+    # called from the module-scoped fixture, NOT at collection time: the
+    # signature probe parses each candidate's metadata (the full vocab
+    # array), too heavy to run on every pytest collection of this module
     out = []
     for d in _SEARCH_DIRS:
         p = Path(d)
         if p.is_dir():
-            # >50 MB: synthetic/spec fixtures are tiny; real quantized
-            # models of any tier are not
-            out.extend(
-                f for f in sorted(p.glob("*.gguf"))
-                if f.stat().st_size > 50e6
-            )
+            out.extend(f for f in sorted(p.glob("*.gguf")) if _looks_real(f))
     return out
-
-
-REAL = _real_files()
 
 
 @pytest.fixture(scope="module")
 def managed_model():
-    if not REAL:
+    real = _real_files()
+    if not real:
         pytest.skip(
             "no real GGUF on this machine (zero-egress build env); run "
             "scripts/download-models.sh and re-run to complete the proof"
         )
     from aios_tpu.runtime.model_manager import ModelManager
 
-    path = REAL[0]
+    path = real[0]
     mgr = ModelManager(num_slots=2, warm_compile=False)
     # exactly the reference's autoload contract: file-size-derived context
     # (runtime/src/main.rs:65-132) via the manager's scan of the file
     m = mgr.load_model(path.stem, str(path))
+    m.real_path = path  # for tests that reload the same file themselves
     yield m
     mgr.unload_model(path.stem)
 
@@ -111,7 +126,7 @@ def test_real_model_serves_through_runtime_service(managed_model):
             rpc.insecure_channel(f"127.0.0.1:{port}")
         )
         st = stub.LoadModel(runtime_pb2.LoadModelRequest(
-            model_name="real", model_path=str(REAL[0])
+            model_name="real", model_path=str(managed_model.real_path)
         ))
         assert st.status == "ready"
         r = stub.Infer(runtime_pb2.InferRequest(

@@ -260,3 +260,44 @@ def test_hbm_budget_counts_co_resident_models(monkeypatch):
     finally:
         mgr.unload_model("a")
         mgr.unload_model("b")
+
+
+def test_seq_shard_force_wins_over_paging(monkeypatch):
+    """An explicit AIOS_TPU_SEQ_SHARD_KV=1 drops the default paged pool
+    and shards the context axis (the operator's force outranks the paging
+    default — they are exclusive on one engine)."""
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    monkeypatch.setenv("AIOS_TPU_MESH", "sp=2")
+    monkeypatch.setenv("AIOS_TPU_PAGED_KV", "auto")
+    monkeypatch.setenv("AIOS_TPU_SEQ_SHARD_KV", "1")
+    monkeypatch.setenv("AIOS_TPU_HBM_GB", "16")
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    m = mgr.load_model("tiny", "synthetic://tiny-test", context_length=128)
+    try:
+        assert m.engine.seq_sharded and not m.engine.paged
+    finally:
+        mgr.unload_model("tiny")
+
+
+def test_hbm_shortfall_warns_without_sp_axis(monkeypatch, caplog):
+    """A KV cache that cannot fit per-chip HBM on a mesh with no sp axis
+    (or a single chip) still WARNS at load, so the first symptom is not a
+    serve-time OOM."""
+    import logging
+
+    from aios_tpu.runtime.model_manager import ModelManager
+
+    monkeypatch.setenv("AIOS_TPU_HBM_GB", "0.000001")
+    monkeypatch.delenv("AIOS_TPU_MESH", raising=False)
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    with caplog.at_level(logging.WARNING, logger="aios.runtime.models"):
+        m = mgr.load_model("tiny", "synthetic://tiny-test", context_length=128)
+    try:
+        assert not m.engine.seq_sharded  # nothing to degrade onto
+        assert any(
+            "seq-sharded degradation is unavailable" in r.message
+            for r in caplog.records
+        )
+    finally:
+        mgr.unload_model("tiny")
