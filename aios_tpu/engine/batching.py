@@ -62,14 +62,16 @@ class _Live:
     first_token_at: float = 0.0
     submitted_at: float = 0.0
     done: bool = False
+    cancelled: bool = False  # set by RequestHandle.cancel(); reaped by _tick
     constraint: object = None  # jsonmode.JsonConstraint when json_mode
 
 
 class RequestHandle:
     """Caller-side view of an in-flight request (blocking token iterator)."""
 
-    def __init__(self, live: _Live):
+    def __init__(self, live: _Live, batcher: "ContinuousBatcher"):
         self._live = live
+        self._batcher = batcher
 
     def __iter__(self):
         while True:
@@ -80,6 +82,15 @@ class RequestHandle:
 
     def tokens(self) -> List[int]:
         return list(self)
+
+    def cancel(self) -> None:
+        """Abort this request: its slot (and KV pages) free at the
+        scheduler's next boundary and the token iterator ends. The llama.cpp
+        parity point — llama-server aborts decode when the HTTP client
+        disconnects — wired to gRPC disconnect by the runtime service.
+        Idempotent; a no-op after completion."""
+        self._live.cancelled = True
+        self._batcher._wake.set()
 
     @property
     def ttft_ms(self) -> float:
@@ -154,6 +165,7 @@ class ContinuousBatcher:
         # policy is to retire the LONGEST request (it has produced the most
         # and frees the most pages) and retry — counted for observability
         self.pool_evictions = 0
+        self.cancellations = 0
         self._waiting: "deque[_Live]" = deque()
         self._qlock = threading.Lock()
         self._prefilling: Optional[Tuple[_Live, ChunkedPrefill]] = None
@@ -298,7 +310,7 @@ class ContinuousBatcher:
         with self._qlock:
             self._waiting.append(live)
         self._wake.set()
-        return RequestHandle(live)
+        return RequestHandle(live, self)
 
     def generate(self, prompt_ids: Sequence[int], **kw) -> List[int]:
         return self.submit(Request(prompt_ids=list(prompt_ids), **kw)).tokens()
@@ -453,6 +465,8 @@ class ContinuousBatcher:
         return forced
 
     def _emit(self, live: _Live, token: int) -> None:
+        if live.cancelled:
+            return  # reaped (slot freed) at the next tick boundary
         live.produced += 1
         live.out_q.put(token)
         hit_stop = token in live.req.stop_ids
@@ -463,15 +477,44 @@ class ContinuousBatcher:
         if hit_stop or out_of_budget or out_of_cache:
             self._finish(live)
 
-    def _finish(self, live: _Live) -> None:
+    def _finish(self, live: _Live, *, was_cancelled: bool = False) -> None:
         live.done = True
         with self._lock:
             self._live.pop(live.slot, None)
         self.engine.release(live.slot)
-        self.completed += 1
+        if was_cancelled:
+            self.cancellations += 1
+        else:
+            self.completed += 1
         # _END goes last: when a consumer unblocks, all scheduler-side state
         # (slot freed, counters bumped) is already final
         live.out_q.put(_END)
+
+    def _reap_cancelled(self) -> None:
+        """Free every cancelled request before admission/decode: queued ones
+        drop out of the wait list, a cancelled chunked admission releases
+        its reserved slot mid-prefill, and live slots release their cache
+        (the pages a disconnected agent was pinning)."""
+        with self._qlock:
+            still = deque()
+            dropped: List[_Live] = []
+            for live in self._waiting:
+                (dropped if live.cancelled else still).append(live)
+            if dropped:
+                self._waiting = still
+        for live in dropped:
+            live.done = True
+            self.cancellations += 1
+            live.out_q.put(_END)
+        if self._prefilling is not None and self._prefilling[0].cancelled:
+            live = self._prefilling[0]
+            self._prefilling = None
+            self._reserved_slot = -1
+            self._finish(live, was_cancelled=True)
+        with self._lock:
+            cancelled = [l for l in self._live.values() if l.cancelled]
+        for live in cancelled:
+            self._finish(live, was_cancelled=True)
 
     def _evict_longest(self, replica: Optional[int] = None) -> bool:
         """Retire the live request with the most cache rows (frees the most
@@ -536,6 +579,7 @@ class ContinuousBatcher:
                 self._abort_all(exc)
 
     def _tick(self) -> None:
+        self._reap_cancelled()
         self._advance_prefill()
         self._admit()
         with self._lock:

@@ -101,6 +101,7 @@ class RuntimeService(AIRuntimeServicer):
                 stats = engine.stats()
                 stats["pool_evictions"] = batcher.pool_evictions
                 stats["completed"] = batcher.completed
+                stats["cancelled"] = batcher.cancellations
                 stats["waiting"] = batcher.queue_depth()
                 stats["num_slots"] = engine.num_slots
                 details[f"{m.name}.serving"] = ",".join(
@@ -142,20 +143,29 @@ class RuntimeService(AIRuntimeServicer):
         )
         emitted = ""
         ids = []
-        for tok in handle:
-            if tok == m.tokenizer.eos_id:
-                break
-            ids.append(tok)
-            # incremental detokenization: emit the stable text delta
-            text = m.tokenizer.decode(ids)
-            if text.startswith(emitted):
-                delta = text[len(emitted) :]
-            else:  # rare resegmentation: resend from scratch marker
-                delta = text
-            if delta:
-                emitted = text
-                yield runtime_pb2.InferChunk(text=delta, done=False)
-        yield runtime_pb2.InferChunk(text="", done=True)
+        try:
+            for tok in handle:
+                if tok == m.tokenizer.eos_id:
+                    break
+                ids.append(tok)
+                # incremental detokenization: emit the stable text delta
+                text = m.tokenizer.decode(ids)
+                if text.startswith(emitted):
+                    delta = text[len(emitted) :]
+                else:  # rare resegmentation: resend from scratch marker
+                    delta = text
+                if delta:
+                    emitted = text
+                    yield runtime_pb2.InferChunk(text=delta, done=False)
+            yield runtime_pb2.InferChunk(text="", done=True)
+        finally:
+            # a cancelled/disconnected client closes this generator at its
+            # yield point (GeneratorExit) — abort the engine request NOW
+            # rather than waiting for the termination callback, so the slot
+            # and KV pages free within one scheduler tick (llama-server
+            # parity: decode stops when the HTTP client goes away). No-op
+            # on normal completion.
+            handle.cancel()
 
     # -- helpers ------------------------------------------------------------
 
@@ -211,7 +221,19 @@ class RuntimeService(AIRuntimeServicer):
             json_schema=schema,
         )
         try:
-            return m.batcher.submit(req), len(prompt_ids)
+            handle = m.batcher.submit(req)
+            if context is not None:
+                # llama-server parity (model_manager.rs spawns a server that
+                # aborts decode when its HTTP client goes away): a gRPC
+                # disconnect/cancel frees the request's slot and KV pages
+                # instead of decoding to max_tokens for nobody. Fires on
+                # normal termination too — cancel() is a no-op then.
+                # add_callback returns False (never firing) when the RPC
+                # already terminated — cancel straight away then, or the
+                # submitted request would decode for a client that is gone.
+                if not context.add_callback(handle.cancel):
+                    handle.cancel()
+            return handle, len(prompt_ids)
         except ValueError as e:
             # unsupported schema constructs / scalar roots fail fast
             if context is not None and schema is not None:

@@ -223,3 +223,94 @@ def test_batcher_serves_int4_engine():
         assert got == want
     finally:
         b.shutdown()
+
+
+def test_cancel_frees_slot_and_ends_iterator(batcher):
+    """cancel() mid-stream releases the request's slot at the next tick and
+    its iterator ends — the disconnect-abort path (llama-server parity:
+    decode stops when the client goes away)."""
+    import time
+
+    h = batcher.submit(Request(
+        prompt_ids=[3, 17, 91], max_tokens=10_000, temperature=0.0
+    ))
+    it = iter(h)
+    next(it)  # live: slot held
+    assert batcher.active_count == 1
+    h.cancel()
+    remaining = list(it)  # ends without producing max_tokens
+    assert len(remaining) < 10_000
+    deadline = time.time() + 5
+    while batcher.active_count and time.time() < deadline:
+        time.sleep(0.01)
+    assert batcher.active_count == 0
+    assert batcher.cancellations == 1
+    # the cancelled slot itself was recycled, not just the other 3
+    assert len(batcher.engine.free_slots()) == batcher.engine.num_slots
+    # the engine still serves new requests afterwards
+    out = batcher.generate([5, 6, 7], max_tokens=4, temperature=0.0)
+    assert len(out) == 4
+
+
+def test_cancel_queued_request_never_occupies_slot():
+    """Cancelling while still queued drops the request from the wait list
+    without touching any slot."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(1), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=1, max_context=128,
+        cache_dtype=jnp.float32,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2)
+    try:
+        hog = b.submit(Request(prompt_ids=[1, 2], max_tokens=64,
+                               temperature=0.0))
+        queued = b.submit(Request(prompt_ids=[3, 4], max_tokens=64,
+                                  temperature=0.0))
+        assert b.queue_depth() >= 1
+        queued.cancel()
+        assert queued.tokens() == []  # ended without ever running
+        assert len(hog.tokens()) == 64  # the live request is unaffected
+        assert b.cancellations == 1
+    finally:
+        b.shutdown()
+
+
+def test_grpc_disconnect_cancels_request():
+    """Closing the gRPC channel mid-StreamInfer aborts the request server-
+    side (context callback -> handle.cancel), freeing the slot."""
+    import time
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    # budget the request CANNOT finish quickly (big context, huge
+    # max_tokens): the tiny model decodes thousands of tok/s on CPU, so a
+    # small context would let out_of_cache complete the request before the
+    # client's cancel crosses the wire (measured: 2048 rows lose the race)
+    mgr.load_model("tiny", "synthetic://tiny-test", context_length=16384)
+    server, service, port = serve(address="127.0.0.1:0", manager=mgr,
+                                  block=False)
+    try:
+        channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = services.AIRuntimeStub(channel)
+        stream = stub.StreamInfer(runtime_pb2.InferRequest(
+            prompt="hello", max_tokens=50_000, temperature=0.5
+        ))
+        next(stream)  # request is live server-side
+        batcher = mgr.models["tiny"].batcher
+        stream.cancel()  # client walks away
+        channel.close()
+        # poll the CANCELLATION counter, not active_count: the live entry
+        # is popped before the counter increments (engine.release sits
+        # between them), so active_count==0 can be observed in that gap
+        deadline = time.time() + 10
+        while batcher.cancellations < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert batcher.cancellations >= 1
+        assert batcher.active_count == 0
+    finally:
+        server.stop(grace=None)
+        mgr.unload_model("tiny")
