@@ -27,6 +27,12 @@ class ProviderError(Exception):
     pass
 
 
+class StreamCancelled(Exception):
+    """The downstream stream was cancelled on purpose (our client went
+    away) — NOT a provider failure: route_stream must not fall back to
+    another provider (e.g. spend cloud budget) for a dead consumer."""
+
+
 @dataclass
 class InferResult:
     text: str
@@ -210,7 +216,8 @@ class LocalRuntimeClient:
         )
 
     def stream_infer(self, prompt: str, system: str, max_tokens: int,
-                     temperature: float, json_schema: str = ""):
+                     temperature: float, json_schema: str = "",
+                     register_call=None):
         """Yield text deltas live from the runtime's StreamInfer.
 
         This is the true-streaming path the reference never had: its
@@ -223,6 +230,7 @@ class LocalRuntimeClient:
 
         from ..proto_gen import runtime_pb2
 
+        stream = None
         try:
             stream = self._get_stub().StreamInfer(
                 runtime_pb2.InferRequest(
@@ -234,11 +242,28 @@ class LocalRuntimeClient:
                 ),
                 timeout=300,
             )
+            if register_call is not None:
+                # hand the call to the servicer so its RPC-termination
+                # callback can cancel it cross-thread while this generator
+                # is parked in next() (cancel is thread-safe on gRPC calls)
+                register_call(stream)
             for chunk in stream:
                 if chunk.text:
                     yield chunk.text
                 if chunk.done:
                     return
         except grpc.RpcError as exc:
+            if exc.code() == grpc.StatusCode.CANCELLED:
+                # our own cross-thread cancel (the gateway client
+                # disconnected) — not a runtime failure, no fallback
+                raise StreamCancelled() from exc
             self._stub = None
             raise ProviderError(f"local runtime: {exc.details()}") from exc
+        finally:
+            # our consumer can vanish mid-stream (the gateway's client
+            # disconnected -> GeneratorExit lands here): cancel the
+            # downstream call so the runtime aborts its decode and frees
+            # the slot, instead of streaming to an abandoned iterator
+            # until max_tokens. No-op on a completed call.
+            if stream is not None:
+                stream.cancel()

@@ -197,6 +197,7 @@ class RequestRouter:
         task_id: str = "",
         use_cache: bool = True,
         json_schema: str = "",
+        register_call=None,
     ):
         """Route with live streaming: yields (text_delta, provider_name).
 
@@ -207,6 +208,12 @@ class RequestRouter:
         the reference's StreamInfer behavior). Fallback to the next
         provider happens only before the first delta is emitted; after
         that, a mid-stream failure surfaces to the caller.
+
+        ``register_call`` (optional) receives each in-flight downstream
+        gRPC call so the gateway servicer can cancel it from its RPC-
+        termination callback — the only abort path when this generator is
+        parked in next() with no delta flowing (a disconnect then never
+        raises GeneratorExit here).
         """
         # same composite key as route() so the two paths share hits
         cache_key = self.cache.key(
@@ -236,6 +243,7 @@ class RequestRouter:
                         for delta in provider.stream_infer(
                             prompt, system, max_tokens, temperature,
                             json_schema=json_schema,
+                            register_call=register_call,
                         ):
                             pieces.append(delta)
                             yield delta, name

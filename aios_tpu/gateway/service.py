@@ -17,7 +17,7 @@ from ..proto_gen import api_gateway_pb2 as pb
 from ..proto_gen import common_pb2
 from ..services import GATEWAY, ApiGatewayServicer, service_address
 from .budget import BudgetManager
-from .providers import ProviderError
+from .providers import ProviderError, StreamCancelled
 from .router import RequestRouter
 
 log = logging.getLogger("aios.gateway")
@@ -58,6 +58,20 @@ class GatewayService(ApiGatewayServicer):
         only for providers without a streaming client — router.route_stream)."""
         provider = ""
         emitted = False
+        # Disconnect propagation while NO delta is flowing: this generator
+        # parks in the provider's next() then, so GeneratorExit can't reach
+        # it — the termination callback cancels the registered downstream
+        # call(s) cross-thread instead, which unblocks the provider loop
+        # and aborts the runtime's decode. Registration after the RPC died
+        # cancels immediately (add_callback no longer fires).
+        downstream = []
+
+        def register_call(call):
+            downstream.append(call)
+            if not context.is_active():
+                call.cancel()
+
+        context.add_callback(lambda: [c.cancel() for c in downstream])
         try:
             for delta, provider in self.router.route_stream(
                 prompt=request.prompt,
@@ -69,9 +83,14 @@ class GatewayService(ApiGatewayServicer):
                 json_schema=getattr(request, "json_schema", ""),
                 agent=request.requesting_agent,
                 task_id=request.task_id,
+                register_call=register_call,
             ):
                 emitted = True
                 yield pb.StreamChunk(text=delta, done=False, provider=provider)
+        except StreamCancelled:
+            # our client is gone and the downstream abort already ran;
+            # nothing to report to nobody
+            return
         except ProviderError as exc:
             if not emitted:
                 context.set_code(grpc.StatusCode.UNAVAILABLE)

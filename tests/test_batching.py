@@ -289,8 +289,8 @@ def test_grpc_disconnect_cancels_request():
     # budget the request CANNOT finish quickly (big context, huge
     # max_tokens): the tiny model decodes thousands of tok/s on CPU, so a
     # small context would let out_of_cache complete the request before the
-    # client's cancel crosses the wire (measured: 2048 rows lose the race)
-    mgr.load_model("tiny", "synthetic://tiny-test", context_length=16384)
+    # client's cancel crosses the wire (measured: 2048 rows lose the race; 8192 wins with seconds to spare)
+    mgr.load_model("tiny", "synthetic://tiny-test", context_length=8192)
     server, service, port = serve(address="127.0.0.1:0", manager=mgr,
                                   block=False)
     try:
@@ -314,3 +314,121 @@ def test_grpc_disconnect_cancels_request():
     finally:
         server.stop(grace=None)
         mgr.unload_model("tiny")
+
+
+def test_gateway_disconnect_propagates_cancel_to_runtime(monkeypatch):
+    """The FULL abort chain: agent disconnects from the gateway mid-stream
+    -> gateway's generator closes -> it cancels its downstream runtime
+    call -> the runtime frees the slot. Without propagation the runtime
+    would stream to an abandoned iterator until max_tokens."""
+    import time
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import api_gateway_pb2
+    from aios_tpu.gateway.router import RequestRouter
+    from aios_tpu.gateway.service import serve as serve_gateway
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve as serve_runtime
+
+    for var in ("CLAUDE_API_KEY", "OPENAI_API_KEY", "QWEN3_API_KEY"):
+        monkeypatch.delenv(var, raising=False)
+    channel = gw_server = rt_server = None
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    try:
+        mgr.load_model("tiny", "synthetic://tiny-test", context_length=8192)
+        rt_server, _, rt_port = serve_runtime(
+            address="127.0.0.1:0", manager=mgr, block=False
+        )
+        gw_server, _, gw_port = serve_gateway(
+            address="127.0.0.1:0",
+            router=RequestRouter(runtime_address=f"127.0.0.1:{rt_port}"),
+            block=False,
+        )
+        channel = rpc.insecure_channel(f"127.0.0.1:{gw_port}")
+        gw = services.ApiGatewayStub(channel)
+        stream = gw.StreamInfer(api_gateway_pb2.ApiInferRequest(
+            prompt="hello", max_tokens=50_000, temperature=0.5
+        ))
+        next(stream)  # live through gateway -> runtime -> engine
+        batcher = mgr.models["tiny"].batcher
+        stream.cancel()
+        deadline = time.time() + 15
+        while batcher.cancellations < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert batcher.cancellations >= 1
+        assert batcher.active_count == 0
+    finally:
+        if channel is not None:
+            channel.close()
+        for server in (gw_server, rt_server):
+            if server is not None:
+                server.stop(grace=None)
+        if mgr.get("tiny") is not None:
+            mgr.unload_model("tiny")
+
+
+def test_gateway_disconnect_while_queued_cancels_without_slot(monkeypatch):
+    """Disconnect before ANY delta flows (request still queued behind busy
+    slots): no GeneratorExit can reach the gateway handler — the RPC-
+    termination callback must cancel the registered downstream call, and
+    the queued request must be reaped without ever taking a slot."""
+    import time
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import api_gateway_pb2, runtime_pb2
+    from aios_tpu.gateway.router import RequestRouter
+    from aios_tpu.gateway.service import serve as serve_gateway
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve as serve_runtime
+
+    for var in ("CLAUDE_API_KEY", "OPENAI_API_KEY", "QWEN3_API_KEY"):
+        monkeypatch.delenv(var, raising=False)
+    channel = rt_channel = gw_server = rt_server = None
+    mgr = ModelManager(num_slots=1, warm_compile=False)
+    try:
+        mgr.load_model("tiny", "synthetic://tiny-test", context_length=8192)
+        rt_server, _, rt_port = serve_runtime(
+            address="127.0.0.1:0", manager=mgr, block=False
+        )
+        gw_server, _, gw_port = serve_gateway(
+            address="127.0.0.1:0",
+            router=RequestRouter(runtime_address=f"127.0.0.1:{rt_port}"),
+            block=False,
+        )
+        channel = rpc.insecure_channel(f"127.0.0.1:{gw_port}")
+        rt_channel = rpc.insecure_channel(f"127.0.0.1:{rt_port}")
+        rt = services.AIRuntimeStub(rt_channel)
+        gw = services.ApiGatewayStub(channel)
+        batcher = mgr.models["tiny"].batcher
+
+        # occupy the ONLY slot directly on the runtime
+        hog = rt.StreamInfer(runtime_pb2.InferRequest(
+            prompt="hog", max_tokens=50_000, temperature=0.5
+        ))
+        next(hog)
+        # gateway request queues behind it (no delta can flow)
+        queued = gw.StreamInfer(api_gateway_pb2.ApiInferRequest(
+            prompt="queued", max_tokens=50_000, temperature=0.5
+        ))
+        deadline = time.time() + 10
+        while batcher.queue_depth() < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert batcher.queue_depth() >= 1
+        queued.cancel()  # disconnect with zero deltas received
+        deadline = time.time() + 15
+        while batcher.cancellations < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert batcher.cancellations >= 1
+        assert batcher.queue_depth() == 0
+        # the hog stream is untouched and still live
+        assert batcher.active_count == 1
+        hog.cancel()
+    finally:
+        for ch in (channel, rt_channel):
+            if ch is not None:
+                ch.close()
+        for server in (gw_server, rt_server):
+            if server is not None:
+                server.stop(grace=None)
+        if mgr.get("tiny") is not None:
+            mgr.unload_model("tiny")
