@@ -27,10 +27,6 @@ class ProviderError(Exception):
     pass
 
 
-class StreamCancelled(Exception):
-    """The downstream stream was cancelled on purpose (our client went
-    away) — NOT a provider failure: route_stream must not fall back to
-    another provider (e.g. spend cloud budget) for a dead consumer."""
 
 
 @dataclass
@@ -253,11 +249,12 @@ class LocalRuntimeClient:
                 if chunk.done:
                     return
         except grpc.RpcError as exc:
-            if exc.code() == grpc.StatusCode.CANCELLED:
-                # our own cross-thread cancel (the gateway client
-                # disconnected) — not a runtime failure, no fallback
-                raise StreamCancelled() from exc
-            self._stub = None
+            # CANCELLED can be our own disconnect-cancel (register_call
+            # path) OR a genuine runtime failure (server restart kills
+            # in-flight RPCs with CANCELLED) — the router tells them apart
+            # via its client_alive probe, not here
+            if exc.code() != grpc.StatusCode.CANCELLED:
+                self._stub = None
             raise ProviderError(f"local runtime: {exc.details()}") from exc
         finally:
             # our consumer can vanish mid-stream (the gateway's client

@@ -198,6 +198,7 @@ class RequestRouter:
         use_cache: bool = True,
         json_schema: str = "",
         register_call=None,
+        client_alive=None,
     ):
         """Route with live streaming: yields (text_delta, provider_name).
 
@@ -213,7 +214,12 @@ class RequestRouter:
         gRPC call so the gateway servicer can cancel it from its RPC-
         termination callback — the only abort path when this generator is
         parked in next() with no delta flowing (a disconnect then never
-        raises GeneratorExit here).
+        raises GeneratorExit here). ``client_alive`` (optional callable)
+        reports whether the consumer still exists: a provider failure with
+        a dead consumer aborts routing instead of falling back (no cloud
+        spend for nobody); it also distinguishes a deliberate
+        disconnect-cancel from a genuine runtime CANCELLED failure, which
+        DOES fall back.
         """
         # same composite key as route() so the two paths share hits
         cache_key = self.cache.key(
@@ -256,6 +262,12 @@ class RequestRouter:
                     except ProviderError as exc:
                         self.last_errors[name] = str(exc)
                         if pieces:  # mid-stream failure: don't restart
+                            raise
+                        if client_alive is not None and not client_alive():
+                            # OUR consumer is gone (the disconnect cancel
+                            # tore the downstream call): falling back would
+                            # spend another provider — possibly cloud
+                            # budget — for a dead client
                             raise
                         errors.append(f"{name}: {exc}")
                         if not allow_fallback:

@@ -17,7 +17,7 @@ from ..proto_gen import api_gateway_pb2 as pb
 from ..proto_gen import common_pb2
 from ..services import GATEWAY, ApiGatewayServicer, service_address
 from .budget import BudgetManager
-from .providers import ProviderError, StreamCancelled
+from .providers import ProviderError
 from .router import RequestRouter
 
 log = logging.getLogger("aios.gateway")
@@ -84,14 +84,15 @@ class GatewayService(ApiGatewayServicer):
                 agent=request.requesting_agent,
                 task_id=request.task_id,
                 register_call=register_call,
+                client_alive=context.is_active,
             ):
                 emitted = True
                 yield pb.StreamChunk(text=delta, done=False, provider=provider)
-        except StreamCancelled:
-            # our client is gone and the downstream abort already ran;
-            # nothing to report to nobody
-            return
         except ProviderError as exc:
+            if not context.is_active():
+                # our client is gone (its disconnect tore the downstream
+                # call); nothing to report to nobody
+                return
             if not emitted:
                 context.set_code(grpc.StatusCode.UNAVAILABLE)
                 context.set_details(str(exc))

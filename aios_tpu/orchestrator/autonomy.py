@@ -545,6 +545,16 @@ class AutonomyLoop:
 
         guided = guided_toolcalls()
         for round_idx in range(max_rounds):
+            if self.engine.is_abandoned(task.id, task.goal_id):
+                # the goal was cancelled (or the task externally
+                # terminated) between rounds: stop burning AI tokens and
+                # executing tools for a dead goal — a strategic task would
+                # otherwise run up to 5 more rounds against its 16k budget
+                log.info(
+                    "reasoning loop for task %s stops: goal %s is "
+                    "cancelled/terminal", task.id, task.goal_id,
+                )
+                return
             # ONE catalog fetch per round, shared by the schema enum and
             # the prompt's tool list (plugin.create can add tools
             # mid-loop; the enum must match what the prompt advertises)

@@ -16,12 +16,15 @@ Reference parity (agent-core/src/goal_engine.rs):
 from __future__ import annotations
 
 import json
+import logging
 import sqlite3
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+log = logging.getLogger("aios.goals")
 
 GOAL_STATES = ("pending", "planning", "in_progress", "completed", "failed",
                "cancelled")
@@ -186,9 +189,30 @@ class GoalEngine:
             g = self.goals.get(goal_id)
             if g is None:
                 return
+            if g.status in TERMINAL_GOAL:
+                # terminal goals are final: CancelGoal can land during the
+                # planner's slow AI decomposition, and the subsequent
+                # add_tasks -> "in_progress" write must not resurrect the
+                # cancelled goal (its tasks would start dispatching)
+                log.info(
+                    "ignoring %s -> %s for terminal goal %s",
+                    g.status, status, goal_id,
+                )
+                return
             g.status = status
             g.updated_at = _now()
             self._persist_goal(g)
+
+    def is_abandoned(self, task_id: str, goal_id: str) -> bool:
+        """True when the goal or the task reached a terminal state — the
+        signal a long-running executor (the reasoning loop) checks between
+        rounds to stop working for a dead goal."""
+        with self._lock:
+            g = self.goals.get(goal_id)
+            t = self.tasks.get(task_id)
+        if g is not None and g.status in TERMINAL_GOAL:
+            return True
+        return t is not None and t.status in TERMINAL_TASK
 
     def cancel_goal(self, goal_id: str) -> bool:
         with self._lock:
@@ -240,10 +264,18 @@ class GoalEngine:
 
     def add_tasks(self, goal_id: str, tasks: List[Task]) -> None:
         with self._lock:
+            goal = self.goals.get(goal_id)
+            dead = goal is not None and goal.status in TERMINAL_GOAL
             for t in tasks:
+                if dead:
+                    # the goal was cancelled while the planner decomposed
+                    # it: record its tasks as cancelled, not as pending
+                    # strays under a terminal goal
+                    t.status = "cancelled"
+                    t.completed_at = _now()
                 self.tasks[t.id] = t
                 self._persist_task(t)
-            if goal_id in self.goals and tasks:
+            if goal is not None and tasks and not dead:
                 self.set_goal_status(goal_id, "in_progress")
 
     def tasks_for_goal(self, goal_id: str) -> List[Task]:
@@ -261,6 +293,16 @@ class GoalEngine:
         with self._lock:
             t = self.tasks.get(task_id)
             if t is None:
+                return
+            if t.status in TERMINAL_TASK:
+                # terminal states are final — name AND payload: a late or
+                # duplicate ReportTaskResult (agent retry after a dropped
+                # response) must neither resurrect a cancelled task nor
+                # overwrite the first report's output/error/completed_at
+                log.info(
+                    "ignoring %s -> %s for terminal task %s",
+                    t.status, status, task_id,
+                )
                 return
             t.status = status
             if agent:

@@ -432,3 +432,80 @@ def test_agent_routing_preferred_over_ai():
     # the agent polls it
     polled = loop.router.next_task_for("system_agent-1")
     assert polled.id == task.id
+
+
+def test_terminal_task_states_are_final():
+    """A late success/failure report must not resurrect a cancelled task
+    (the cancel record would be silently overwritten)."""
+    e = GoalEngine()
+    g = e.submit_goal("cancel me mid-flight")
+    e.add_tasks(g.id, [Task(id="t1", goal_id=g.id, description="a")])
+    e.set_task_status("t1", "in_progress")
+    assert e.cancel_goal(g.id)
+    e.complete_task("t1", output={"late": "report"})
+    assert e.tasks["t1"].status == "cancelled"
+    e.set_task_status("t1", "failed", error="late failure")
+    assert e.tasks["t1"].status == "cancelled"
+    # same terminal state re-set stays a no-op-safe path
+    e.set_task_status("t1", "cancelled")
+    assert e.tasks["t1"].status == "cancelled"
+
+
+def test_reasoning_loop_stops_on_cancelled_goal():
+    """CancelGoal mid-reasoning: the loop must not run further AI rounds
+    or tool calls for a dead goal (checked between rounds)."""
+    e = GoalEngine()
+    tools = FakeTools()
+    ai_calls = []
+
+    def gateway(prompt, level, json_schema=""):
+        ai_calls.append(level)
+        # cancel the goal the moment the FIRST reply lands; reply carries
+        # a tool call so an unchecked loop would keep going for up to
+        # 5 strategic rounds
+        e.cancel_goal(goal_holder["id"])
+        return json.dumps({
+            "thought": "working",
+            "tool_calls": [{"tool": "monitor.cpu", "args": {}}],
+            "done": False,
+        })
+
+    loop = _loop(e, tools=tools, gateway=gateway)
+    goal_holder = {}
+    g = e.submit_goal(
+        "design and implement a comprehensive multi-phase migration plan "
+        "for the storage architecture"  # strategic-complexity wording
+    )
+    goal_holder["id"] = g.id
+    _drain(loop)
+    assert len(ai_calls) == 1, f"loop kept reasoning: {ai_calls}"
+    # the cancelled task was not resurrected by a late record
+    for t in e.tasks_for_goal(g.id):
+        assert t.status == "cancelled"
+
+
+def test_cancel_during_decomposition_not_resurrected():
+    """CancelGoal landing while the planner's slow AI decomposition runs:
+    the late add_tasks must not flip the cancelled goal back to
+    in_progress, and its tasks must arrive cancelled, not as dispatchable
+    strays."""
+    e = GoalEngine()
+    g = e.submit_goal("cancel mid-planning")
+    e.set_goal_status(g.id, "planning")
+    assert e.cancel_goal(g.id)
+    e.add_tasks(g.id, [Task(id="late1", goal_id=g.id, description="a"),
+                       Task(id="late2", goal_id=g.id, description="b")])
+    assert e.goals[g.id].status == "cancelled"
+    assert all(t.status == "cancelled" for t in e.tasks_for_goal(g.id))
+    assert e.unblocked_pending_tasks(limit=10) == []
+
+
+def test_duplicate_terminal_report_keeps_first_payload():
+    """An agent retry after a dropped response re-reports a completed
+    task: the duplicate must not overwrite the first report's output."""
+    e = GoalEngine()
+    g = e.submit_goal("report twice")
+    e.add_tasks(g.id, [Task(id="t1", goal_id=g.id, description="a")])
+    e.complete_task("t1", output={"first": True})
+    e.complete_task("t1", output={"second": True})
+    assert e.tasks["t1"].output == {"first": True}
