@@ -63,6 +63,10 @@ class _Live:
     submitted_at: float = 0.0
     done: bool = False
     cancelled: bool = False  # set by RequestHandle.cancel(); reaped by _tick
+    # non-empty when the request was ABORTED (scheduler failure, model
+    # unload) rather than finished/cancelled — consumers must not present
+    # the truncated output as a normal completion
+    abort_reason: str = ""
     constraint: object = None  # jsonmode.JsonConstraint when json_mode
 
 
@@ -91,6 +95,17 @@ class RequestHandle:
         Idempotent; a no-op after completion."""
         self._live.cancelled = True
         self._batcher._wake.set()
+
+    @property
+    def aborted(self) -> bool:
+        """True when the stream ended by ABORT (scheduler failure, model
+        unload) — the collected tokens are a truncation, not a
+        completion; serving layers map this to an error status."""
+        return bool(self._live.abort_reason)
+
+    @property
+    def abort_reason(self) -> str:
+        return self._live.abort_reason
 
     @property
     def ttft_ms(self) -> float:
@@ -166,6 +181,7 @@ class ContinuousBatcher:
         # and frees the most pages) and retry — counted for observability
         self.pool_evictions = 0
         self.cancellations = 0
+        self._closed = False  # set by shutdown(); submit() refuses after
         self._waiting: "deque[_Live]" = deque()
         self._qlock = threading.Lock()
         self._prefilling: Optional[Tuple[_Live, ChunkedPrefill]] = None
@@ -308,6 +324,11 @@ class ContinuousBatcher:
 
             live.constraint = jsonmode.JsonConstraint(self._json_mask_cache())
         with self._qlock:
+            if self._closed:
+                # shutdown() already drained the queue; an enqueue now
+                # would never be scheduled NOR terminated — its consumer
+                # would block forever (the UnloadModel/submit race)
+                raise RuntimeError("batcher is shut down")
             self._waiting.append(live)
         self._wake.set()
         return RequestHandle(live, self)
@@ -316,9 +337,32 @@ class ContinuousBatcher:
         return self.submit(Request(prompt_ids=list(prompt_ids), **kw)).tokens()
 
     def shutdown(self) -> None:
+        with self._qlock:
+            self._closed = True  # new submits refuse from here on
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            # a long dispatch (large-model prefill) can hold _tick past
+            # 10 s; the loop exits right after it sees _stop, so wait
+            # more before touching shared state
+            log.warning("batcher scheduler still in a dispatch; waiting")
+            self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            # wedged dispatch (e.g. a dead TPU tunnel): releasing slots
+            # under a thread that may still write them risks use-after-
+            # free — leave the state alone and surface the condition
+            log.error(
+                "batcher scheduler did not stop after 70s; outstanding "
+                "requests are NOT terminated (wedged dispatch?)"
+            )
+            return
+        # terminate every outstanding request AFTER the scheduler stopped:
+        # nothing will ever deliver their end-of-stream once the thread is
+        # gone, so a consumer blocked in out_q.get() — e.g. a StreamInfer
+        # handler whose model is being UnloadModel'ed mid-stream — would
+        # hang forever
+        self._terminate_outstanding("model unloading")
 
     @property
     def active_count(self) -> int:
@@ -551,6 +595,14 @@ class ContinuousBatcher:
         and the error is kept for inspection."""
         self.last_error = exc
         log.exception("continuous batcher scheduler failed; aborting requests")
+        self._terminate_outstanding(f"scheduler failed: {exc!r}"[:200])
+
+    def _terminate_outstanding(self, reason: str) -> None:
+        """End every live / mid-prefill / queued request (slot released,
+        iterator ends with its abort_reason set, so the serving layer
+        reports an error instead of presenting the truncation as a normal
+        completion). Called on scheduler failure and on shutdown — any
+        path after which no scheduler pass will run again."""
         victims: List[_Live] = []
         if self._prefilling is not None:
             victims.append(self._prefilling[0])
@@ -564,6 +616,7 @@ class ContinuousBatcher:
             self._waiting.clear()
         for live in victims:
             live.done = True
+            live.abort_reason = reason
             if live.slot >= 0:
                 try:
                     self.engine.release(live.slot)

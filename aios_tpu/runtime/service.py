@@ -125,6 +125,14 @@ class RuntimeService(AIRuntimeServicer):
             return runtime_pb2.InferResponse()
         handle, n_prompt = self._submit(m, request, context=context)
         token_ids = [t for t in handle if t != m.tokenizer.eos_id]
+        if handle.aborted:
+            # mid-request abort (model unload, scheduler failure): the
+            # collected tokens are a truncation — error out, don't present
+            # them as a completion
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"request aborted: {handle.abort_reason}",
+            )
         text = m.tokenizer.decode(token_ids)
         latency_ms = int((time.time() - t0) * 1000)
         return runtime_pb2.InferResponse(
@@ -157,6 +165,14 @@ class RuntimeService(AIRuntimeServicer):
                 if delta:
                     emitted = text
                     yield runtime_pb2.InferChunk(text=delta, done=False)
+            if handle.aborted:
+                # ABORTED status instead of a done-chunk: the client must
+                # not mistake a mid-stream unload for a short completion
+                context.set_code(grpc.StatusCode.ABORTED)
+                context.set_details(
+                    f"stream aborted: {handle.abort_reason}"
+                )
+                return
             yield runtime_pb2.InferChunk(text="", done=True)
         finally:
             # a cancelled/disconnected client closes this generator at its
@@ -221,7 +237,17 @@ class RuntimeService(AIRuntimeServicer):
             json_schema=schema,
         )
         try:
-            handle = m.batcher.submit(req)
+            try:
+                handle = m.batcher.submit(req)
+            except RuntimeError as e:
+                # submit raced UnloadModel's shutdown: the batcher refuses
+                # (rather than stranding the consumer forever)
+                if context is not None:
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"model {m.name} is unloading: {e}",
+                    )
+                raise
             if context is not None:
                 # llama-server parity (model_manager.rs spawns a server that
                 # aborts decode when its HTTP client goes away): a gRPC

@@ -432,3 +432,84 @@ def test_gateway_disconnect_while_queued_cancels_without_slot(monkeypatch):
                 server.stop(grace=None)
         if mgr.get("tiny") is not None:
             mgr.unload_model("tiny")
+
+
+def test_shutdown_terminates_outstanding_requests():
+    """shutdown() (the UnloadModel path) must end every in-flight and
+    queued request's iterator — after the scheduler thread dies nothing
+    else will ever deliver their end-of-stream."""
+    import queue as _q
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(2), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=1, max_context=8192,
+        cache_dtype=jnp.float32,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2)
+    live = b.submit(Request(prompt_ids=[1, 2], max_tokens=100_000,
+                            temperature=0.0))
+    queued = b.submit(Request(prompt_ids=[3, 4], max_tokens=100_000,
+                              temperature=0.0))
+    results = _q.Queue()
+
+    def consume(h):
+        results.put(len(h.tokens()))
+
+    t1 = threading.Thread(target=consume, args=(live,), daemon=True)
+    t2 = threading.Thread(target=consume, args=(queued,), daemon=True)
+    t1.start(); t2.start()
+    # wait until the first request is actually decoding
+    deadline = __import__("time").time() + 30
+    while b.active_count < 1 and __import__("time").time() < deadline:
+        __import__("time").sleep(0.05)
+    b.shutdown()
+    t1.join(timeout=30); t2.join(timeout=30)
+    assert not t1.is_alive() and not t2.is_alive(), (
+        "consumers still blocked after shutdown"
+    )
+    assert results.qsize() == 2  # both iterators ended
+    # terminated ≠ completed: both handles carry the abort marker so the
+    # serving layer reports an error, not a short success
+    assert live.aborted and "unload" in live.abort_reason
+    assert queued.aborted
+    # and the closed batcher refuses new work instead of stranding it
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.submit(Request(prompt_ids=[9], max_tokens=4))
+
+
+def test_unload_mid_stream_surfaces_aborted_to_client():
+    """UnloadModel while a StreamInfer is mid-generation: the client gets
+    an ABORTED status, not a truncated stream that looks complete."""
+    import time
+
+    import grpc as grpc_mod
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    mgr.load_model("tiny", "synthetic://tiny-test", context_length=8192)
+    server, _, port = serve(address="127.0.0.1:0", manager=mgr, block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = services.AIRuntimeStub(channel)
+        stream = stub.StreamInfer(runtime_pb2.InferRequest(
+            prompt="hello", max_tokens=50_000, temperature=0.5
+        ))
+        next(stream)  # live
+        t = threading.Thread(target=mgr.unload_model, args=("tiny",),
+                             daemon=True)
+        t.start()
+        with pytest.raises(grpc_mod.RpcError) as err:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                next(stream)
+        assert err.value.code() == grpc_mod.StatusCode.ABORTED
+        assert "unload" in err.value.details()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        channel.close()
+        server.stop(grace=None)
