@@ -794,3 +794,66 @@ def test_paged_decode_step_int8_kernel_wiring(monkeypatch):
     assert called.get("hit")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_paged_cancel_eviction_prefix_soak(params):
+    """Randomized soak over the riskiest composition: shared-prefix pages
+    (refcounted), pool-exhaustion eviction, and request CANCELLATION all
+    interleaving on one paged engine. Invariant at quiesce: page
+    accounting balances exactly — every page is free or pinned by the
+    prefix index; nothing leaks, nothing double-frees. The pool is sized
+    to GUARANTEE exhaustion (asserted below), so the eviction path really
+    interleaves with the cancel reaping."""
+    import random
+    import threading
+    import time
+
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+
+    rng = random.Random(7)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=4, max_context=256,
+        cache_dtype=jnp.float32, paged_pool_rows=256, page_size=32,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=1)
+    preamble = [7] * 64  # two full pages shared across most requests
+    handles = []
+    try:
+        for i in range(24):
+            prompt = (preamble if i % 3 else [5, i + 1]) + [
+                rng.randrange(1, 250) for _ in range(rng.randrange(1, 30))
+            ]
+            handles.append(b.submit(Request(
+                prompt_ids=prompt, max_tokens=rng.randrange(40, 150),
+                temperature=0.0,
+            )))
+            if i % 2:
+                victim = rng.choice(handles)
+                victim.cancel()  # may be queued, live, or already done
+            time.sleep(rng.random() * 0.02)
+        drainers = [threading.Thread(target=h.tokens, daemon=True)
+                    for h in handles]
+        for t in drainers:
+            t.start()
+        end = time.time() + 120  # shared deadline, not 120 s per thread
+        for t in drainers:
+            t.join(timeout=max(0.1, end - time.time()))
+        assert all(not t.is_alive() for t in drainers), "stranded consumer"
+        assert b.active_count == 0 and b.queue_depth() == 0
+        # the composition actually happened: evictions AND cancellations
+        assert b.pool_evictions > 0, "pool never exhausted; soak is vacuous"
+        assert b.cancellations > 0
+        alloc = engine.allocator
+        # quiesced accounting: usable pages (total minus the sacrificial
+        # page) = free pages + pages pinned by the prefix index
+        pinned = len(set(engine.prefix_index._index.values()))
+        usable = alloc.num_pages - alloc.replicas
+        assert alloc.free_pages + pinned == usable, (
+            alloc.free_pages, pinned, usable,
+        )
+        # no slot holds rows after quiesce
+        for s in range(engine.num_slots):
+            assert alloc.slot_rows_backed(s) == 0
+    finally:
+        b.shutdown()
+        engine.close()
