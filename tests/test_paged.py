@@ -808,8 +808,6 @@ def test_paged_cancel_eviction_prefix_soak(params):
     import threading
     import time
 
-    from aios_tpu.engine.batching import ContinuousBatcher, Request
-
     rng = random.Random(7)
     engine = TPUEngine(
         TINY_TEST, params, num_slots=4, max_context=256,

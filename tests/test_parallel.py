@@ -607,3 +607,53 @@ def test_paged_pool_dp_replicated_int8_kv(cpu_devices):
     finally:
         eng.close()
         ref.close()
+
+
+def test_dp_pool_cancel_frees_replica_pages(cpu_devices):
+    """Request cancellation on a dp-replicated pool: the reap frees the
+    victim's pages on ITS replica, the co-resident stream on the other
+    replica is untouched, and the replica's free-page count returns to its
+    baseline (no leak in the replica-local allocator)."""
+    import time
+
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+
+    cfg = TINY_TEST
+    params = M.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    eng = TPUEngine(
+        cfg, params, shardings=plan, num_slots=4, max_context=4096,
+        cache_dtype=jnp.float32, paged_pool_rows=1024, page_size=16,
+    )
+    b = ContinuousBatcher(eng, chunk_steps=2, admit_chunk_steps=2)
+    try:
+        alloc = eng.allocator
+        baseline = [alloc.free_pages_for(0), alloc.free_pages_for(2)]
+        # one long-running request per replica (slots 0-1 -> replica 0,
+        # 2-3 -> replica 1; the batcher picks the emptier replica)
+        h0 = b.submit(Request(prompt_ids=[1, 2, 3], max_tokens=100_000,
+                              temperature=0.0))
+        h1 = b.submit(Request(prompt_ids=[4, 5, 6], max_tokens=100_000,
+                              temperature=0.0))
+        deadline = time.time() + 60
+        while b.active_count < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert b.active_count == 2
+        # record the victim's placement BEFORE cancelling — the survivor's
+        # later fate (it may self-evict at its replica's pool cap) must
+        # not matter to the assertions
+        victim_slot = h0._live.slot
+        victim_replica = alloc.replica_of(victim_slot)
+        survivor_replica = alloc.replica_of(h1._live.slot)
+        assert {victim_replica, survivor_replica} == {0, 1}
+        h0.cancel()
+        deadline = time.time() + 30
+        while b.cancellations < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert b.cancellations == 1
+        assert not h1.aborted  # the other replica's stream was untouched
+        # the cancelled stream's replica got all its pages back
+        assert alloc.free_pages_for(victim_slot) == baseline[victim_replica]
+    finally:
+        b.shutdown()
+        eng.close()
