@@ -480,7 +480,11 @@ def test_int4_engine_decode_matches_dense_on_fixed_point():
     assert out_f[:3] == out_q[:3], (out_f, out_q)
 
 
-def test_int4_downgrades_to_int8_under_sharding_plan():
+def test_int4_composes_with_sharding_plan():
+    """int4 serving under a TP plan (the round-4 composition that replaced
+    the old downgrade-to-int8 rule): eligibility/scale groups computed on
+    shard-local dims, quant_mode stays int4, and the sharded engine
+    decodes token-identically to the single-chip int4 engine."""
     from aios_tpu.engine.engine import TPUEngine
     from aios_tpu.engine import model as M
     from aios_tpu.engine.config import TINY_TEST
@@ -489,9 +493,25 @@ def test_int4_downgrades_to_int8_under_sharding_plan():
     params = M.init_params(TINY_TEST, jax.random.PRNGKey(17), dtype=jnp.float32)
     plan = ShardingPlan(build_mesh(tp=2, n_devices=2))
     eng = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
-                    shardings=plan, quantize="int4")
-    assert eng.quant_mode == "int8"
-    assert "q" in eng.params["layers"]["wq"]
+                    cache_dtype=jnp.float32, shardings=plan, quantize="int4")
+    assert eng.quant_mode == "int4"
+    # off-TPU, storage-eligible dims stay int4 (the jnp reference path
+    # dequantizes inline either way); on TPU, shard-ineligible leaves fall
+    # back per leaf — covered by the kernel-rule tests in test_checkpoint
+    assert any(
+        isinstance(v, dict) and "q4" in v
+        for v in eng.params["layers"].values()
+    )
+    solo = TPUEngine(TINY_TEST, params, num_slots=2, max_context=64,
+                     cache_dtype=jnp.float32, quantize="int4")
+    prompt = [1, 5, 9, 2]
+    got = eng.generate(prompt, max_new_tokens=8, temperature=0.0)
+    want = solo.generate(prompt, max_new_tokens=8, temperature=0.0)
+    # the single-chip engine quantizes the FUSED layout, the sharded one
+    # the unfused tp-grouped layout — different rounding, so late tokens
+    # may drift on a random tiny model (same caveat as the dense-vs-int4
+    # test above); the early greedy steps must agree exactly
+    assert got[:4] == want[:4], (got, want)
 
 
 def test_int4_clip_search_beats_plain_rtn():
