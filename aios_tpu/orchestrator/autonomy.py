@@ -67,45 +67,53 @@ TOKEN_BUDGETS = {REACTIVE: 2048, OPERATIONAL: 2048, TACTICAL: 8192,
                  STRATEGIC: 16384}
 
 
+class InferenceCancelled(Exception):
+    """An in-flight AI inference was aborted on purpose (its goal was
+    cancelled) — not a backend failure: no fallback, no task failure."""
+
+
 def _call_with_budget(
-    backend, prompt: str, level: str, budget: int, json_schema: str = ""
+    backend, prompt: str, level: str, budget: int, json_schema: str = "",
+    cancel_event=None,
 ) -> str:
     """Invoke an infer backend, passing the token budget when it takes one
-    and the structured-output schema when it is accepted.
+    and the structured-output schema / cancel event when accepted.
 
     Production closures (orchestrator/main.py) have signature
-    (prompt, level, max_tokens, json_schema=""); two-arg callables are
-    grandfathered so injected fakes keep working.
+    (prompt, level, max_tokens, json_schema="", cancel_event=None);
+    two-arg callables are grandfathered so injected fakes keep working.
     """
     import inspect
 
     takes_schema = False
+    takes_cancel = False
     try:
         sig = inspect.signature(backend)
         params = sig.parameters.values()
-        # json_schema is always passed BY KEYWORD, so it must not count
-        # toward the positional-budget slot (a backend like
+        # json_schema/cancel_event are always passed BY KEYWORD, so they
+        # must not count toward the positional-budget slot (a backend like
         # f(prompt, level, json_schema="") takes no budget)
         positional = [
             p for p in params
             if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
-            and p.name != "json_schema"
+            and p.name not in ("json_schema", "cancel_event")
         ]
         takes_budget = len(positional) >= 3 or any(
             p.kind is p.VAR_POSITIONAL for p in params
         )
-        takes_schema = "json_schema" in sig.parameters or any(
-            p.kind is p.VAR_KEYWORD for p in params
-        )
+        var_kw = any(p.kind is p.VAR_KEYWORD for p in params)
+        takes_schema = "json_schema" in sig.parameters or var_kw
+        takes_cancel = "cancel_event" in sig.parameters or var_kw
     except (TypeError, ValueError):
         takes_budget = True
+    kw = {}
     if json_schema and takes_schema:
-        if takes_budget:
-            return backend(prompt, level, budget, json_schema=json_schema)
-        return backend(prompt, level, json_schema=json_schema)
+        kw["json_schema"] = json_schema
+    if cancel_event is not None and takes_cancel:
+        kw["cancel_event"] = cancel_event
     if takes_budget:
-        return backend(prompt, level, budget)
-    return backend(prompt, level)
+        return backend(prompt, level, budget, **kw)
+    return backend(prompt, level, **kw)
 TOOL_RESULT_TRUNCATE = 1000
 MAX_AI_MESSAGES = 3  # awaiting_input cap (autonomy.rs:2431-2480)
 MAX_PARALLEL_AI = 3
@@ -354,6 +362,10 @@ class AutonomyLoop:
             thread_name_prefix="autonomy",
         )
         self._in_flight: set = set()
+        # task_id -> (goal_id, Event): in-flight AI inferences abortable
+        # by CancelGoal (notify_goal_cancelled); registered per reasoning
+        # task for its loop's duration
+        self._cancel_watch: Dict[str, Tuple[str, threading.Event]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -470,7 +482,8 @@ class AutonomyLoop:
                     self._in_flight.discard(task.id)
 
     def _ai_infer(
-        self, prompt: str, level: str, json_schema: str = ""
+        self, prompt: str, level: str, json_schema: str = "",
+        cancel_event=None,
     ) -> Optional[str]:
         """gateway (preferred qwen3) -> runtime fallback chain.
 
@@ -478,7 +491,9 @@ class AutonomyLoop:
         (TOKEN_BUDGETS; autonomy.rs:596-607 enforces 2048/2048/8192/16384
         max_tokens by level) — backends forward it as the InferRequest /
         ApiInferRequest max_tokens field. Two-arg backends (legacy tests,
-        simple fakes) are still accepted.
+        simple fakes) are still accepted. ``cancel_event`` aborts an
+        in-flight inference when its goal is cancelled (no fallback then —
+        a deliberate abort is not a backend failure).
         """
         budget = TOKEN_BUDGETS.get(level, TOKEN_BUDGETS[OPERATIONAL])
         for backend in (self.gateway_infer, self.runtime_infer):
@@ -486,8 +501,11 @@ class AutonomyLoop:
                 continue
             try:
                 return _call_with_budget(
-                    backend, prompt, level, budget, json_schema
+                    backend, prompt, level, budget, json_schema,
+                    cancel_event=cancel_event,
                 )
+            except InferenceCancelled:
+                return None
             except Exception as exc:  # noqa: BLE001
                 log.warning("AI backend failed: %s", exc)
                 continue
@@ -535,8 +553,32 @@ class AutonomyLoop:
         parts.append(TOOL_CALL_FORMAT)
         return "\n\n".join(parts)
 
+    def notify_goal_cancelled(self, goal_id: str) -> None:
+        """CancelGoal hook: abort any IN-FLIGHT AI inference working for
+        the dead goal right now (the between-rounds is_abandoned check
+        only stops FUTURE rounds; this stops the current one)."""
+        with self._lock:
+            events = [
+                ev for gid, ev in self._cancel_watch.values()
+                if gid == goal_id
+            ]
+        for ev in events:
+            ev.set()
+
     def run_reasoning_loop(self, task: Task) -> None:
         """Multi-round observe->think->act (autonomy.rs:100-224)."""
+        cancel_event = threading.Event()
+        with self._lock:
+            self._cancel_watch[task.id] = (task.goal_id, cancel_event)
+        try:
+            self._run_reasoning_rounds(task, cancel_event)
+        finally:
+            with self._lock:
+                self._cancel_watch.pop(task.id, None)
+
+    def _run_reasoning_rounds(
+        self, task: Task, cancel_event: threading.Event
+    ) -> None:
         level = task.intelligence_level or OPERATIONAL
         max_rounds = MAX_ROUNDS.get(level, 1)
         all_results: List[dict] = []
@@ -563,8 +605,13 @@ class AutonomyLoop:
                 json.dumps(toolcalls_schema(catalog)) if guided else ""
             )
             prompt = self._build_prompt(task, all_results, round_idx, catalog)
-            reply = self._ai_infer(prompt, level, schema_json)
+            reply = self._ai_infer(prompt, level, schema_json,
+                                   cancel_event=cancel_event)
             if reply is None:
+                if self.engine.is_abandoned(task.id, task.goal_id):
+                    # the in-flight inference was ABORTED by CancelGoal
+                    # (notify_goal_cancelled), not a backend failure
+                    return
                 self._record_failure(task, "no AI backend available")
                 return
 
@@ -576,14 +623,24 @@ class AutonomyLoop:
                     "Your previous reply was not valid JSON.\n"
                     f"Previous reply:\n{reply[:800]}\n\n" + TOOL_CALL_FORMAT
                 )
-                reply = self._ai_infer(correction, level, schema_json)
+                reply = self._ai_infer(correction, level, schema_json,
+                                       cancel_event=cancel_event)
                 if reply is None:
+                    if self.engine.is_abandoned(task.id, task.goal_id):
+                        return
                     self._record_failure(task, "no AI backend available")
                     return
                 calls, done, thought = parse_tool_calls(reply)
 
             if thought:
                 final_thought = thought
+
+            if cancel_event.is_set():
+                # the cancel raced the reply's arrival (result landed in
+                # the same poll window): do NOT execute this round's tool
+                # calls — they may side-effect (fs.write, email, plugins)
+                # for a goal the user just killed
+                return
 
             if calls:
                 made_any_call = True

@@ -20,7 +20,12 @@ import grpc
 
 from ..proto_gen import api_gateway_pb2, memory_pb2, runtime_pb2, tools_pb2
 from .agent_router import AgentRouter
-from .autonomy import TOKEN_BUDGETS, AutonomyConfig, AutonomyLoop
+from .autonomy import (
+    TOKEN_BUDGETS,
+    AutonomyConfig,
+    AutonomyLoop,
+    InferenceCancelled,
+)
 from .clients import HealthChecker, ServiceClients, ServiceRegistry
 from .cluster import ClusterManager, RemoteExecutor
 from .event_bus import EventBus, Subscription
@@ -46,13 +51,33 @@ def build_orchestrator(
 
     # --- gRPC glue ---------------------------------------------------------
 
+    def _infer_future(method, request, cancel_event):
+        """Run a unary infer as a cancellable future: when cancel_event
+        fires (CancelGoal mid-inference), cancel the gRPC call — the
+        server's RPC-termination callback then aborts the downstream
+        decode/cloud call — and raise InferenceCancelled so the autonomy
+        loop stops without recording a failure."""
+        fut = method.future(request, timeout=150)
+        if cancel_event is None:
+            return fut.result()
+        while True:
+            if cancel_event.is_set():
+                fut.cancel()
+                raise InferenceCancelled()
+            try:
+                return fut.result(timeout=0.1)
+            except grpc.FutureTimeoutError:
+                continue
+
     def gateway_infer(prompt: str, level: str = "", max_tokens: int = 0,
-                      json_schema: str = "") -> str:
+                      json_schema: str = "", cancel_event=None) -> str:
         """max_tokens carries the autonomy loop's per-level reasoning budget
         (autonomy.TOKEN_BUDGETS; reference autonomy.rs:596-607);
         json_schema the guided tool_calls shape (AIOS_TPU_GUIDED_TOOLCALLS),
-        honored by the local TPU provider."""
-        resp = clients.gateway.Infer(
+        honored by the local TPU provider; cancel_event aborts the call
+        mid-flight when its goal is cancelled."""
+        resp = _infer_future(
+            clients.gateway.Infer,
             api_gateway_pb2.ApiInferRequest(
                 prompt=prompt,
                 max_tokens=max_tokens,
@@ -61,13 +86,14 @@ def build_orchestrator(
                 requesting_agent="autonomy-loop",
                 json_schema=json_schema,
             ),
-            timeout=150,
+            cancel_event,
         )
         return resp.text
 
     def runtime_infer(prompt: str, level: str = "", max_tokens: int = 0,
-                      json_schema: str = "") -> str:
-        resp = clients.runtime.Infer(
+                      json_schema: str = "", cancel_event=None) -> str:
+        resp = _infer_future(
+            clients.runtime.Infer,
             runtime_pb2.InferRequest(
                 prompt=prompt,
                 max_tokens=max_tokens,
@@ -75,7 +101,7 @@ def build_orchestrator(
                 requesting_agent="autonomy-loop",
                 json_schema=json_schema,
             ),
-            timeout=150,
+            cancel_event,
         )
         return resp.text
 

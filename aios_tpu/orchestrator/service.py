@@ -94,6 +94,10 @@ class OrchestratorService(OrchestratorServicer):
 
     def CancelGoal(self, request, context):
         ok = self.engine.cancel_goal(request.id)
+        if ok and self.autonomy is not None:
+            # abort any IN-FLIGHT AI inference for the dead goal now — the
+            # loop's between-rounds check only stops future rounds
+            self.autonomy.notify_goal_cancelled(request.id)
         return common_pb2.Status(
             success=ok, message="cancelled" if ok else "not cancellable"
         )

@@ -509,3 +509,46 @@ def test_duplicate_terminal_report_keeps_first_payload():
     e.complete_task("t1", output={"first": True})
     e.complete_task("t1", output={"second": True})
     assert e.tasks["t1"].output == {"first": True}
+
+
+def test_cancel_aborts_in_flight_inference():
+    """notify_goal_cancelled (the CancelGoal hook) must abort the AI call
+    that is IN FLIGHT right now — via the cancel_event threaded to the
+    backend — and the loop must return without recording a failure."""
+    import threading
+
+    from aios_tpu.orchestrator.autonomy import InferenceCancelled
+
+    e = GoalEngine()
+    started = threading.Event()
+    released = threading.Event()
+    state = {"cancelled_seen": False, "calls": 0}
+
+    def gateway(prompt, level, max_tokens, json_schema="", cancel_event=None):
+        state["calls"] += 1
+        started.set()
+        # block like a slow AI call until the cancel (or give up)
+        if cancel_event.wait(timeout=20):
+            state["cancelled_seen"] = True
+            released.set()
+            raise InferenceCancelled()
+        released.set()
+        return json.dumps({"thought": "x", "tool_calls": [], "done": True})
+
+    loop = _loop(e, gateway=gateway)
+    g = e.submit_goal(
+        "design a comprehensive multi-phase migration strategy for storage"
+    )
+    # drive ticks until the AI call is in flight
+    deadline = time.time() + 10
+    while not started.is_set() and time.time() < deadline:
+        loop.tick()
+        time.sleep(0.01)
+    assert started.is_set()
+    e.cancel_goal(g.id)
+    loop.notify_goal_cancelled(g.id)
+    assert released.wait(timeout=10), "backend never saw the cancel"
+    _drain(loop)
+    assert state["cancelled_seen"] and state["calls"] == 1
+    for t in e.tasks_for_goal(g.id):
+        assert t.status == "cancelled", t.status  # no failure recorded
