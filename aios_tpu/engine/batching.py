@@ -33,6 +33,11 @@ log = logging.getLogger("aios.batcher")
 
 _END = object()
 
+# Queued requests gain +1 effective priority per this many seconds
+# waiting, bounding starvation under sustained higher-priority traffic
+# (a priority-0 request outranks a fresh strategic (3) after ~15 s).
+PRIORITY_AGING_SECS = 5.0
+
 
 @dataclass
 class Request:
@@ -51,6 +56,11 @@ class Request:
     # typed scalars, nested/any subtrees). Wins over json_mode when both
     # are set (it is the stricter guarantee).
     json_schema: Optional[dict] = None
+    # admission priority: higher admits first when slots are contended
+    # (FIFO within a priority level — no wire field; the runtime derives
+    # it from the request's intelligence level so strategic reasoning
+    # doesn't queue behind bulk operational traffic)
+    priority: int = 0
 
 
 @dataclass
@@ -414,7 +424,18 @@ class ContinuousBatcher:
             with self._qlock:
                 if not self._waiting:
                     return
-                live = self._waiting.popleft()
+                # highest EFFECTIVE priority admits first: queue age adds
+                # +1 level per AGING_SECS, so sustained high-priority
+                # traffic cannot starve a waiting request forever, and
+                # within a level the continuous boost makes the oldest
+                # strictly maximal (FIFO holds)
+                now = time.monotonic()
+                live = max(
+                    self._waiting,
+                    key=lambda l: l.req.priority
+                    + (now - l.submitted_at) / PRIORITY_AGING_SECS,
+                )
+                self._waiting.remove(live)
             alloc = self.engine.allocator
             if alloc is not None and alloc.replicas > 1:
                 # dp-partitioned pool: admit onto the replica with the

@@ -235,6 +235,13 @@ class RuntimeService(AIRuntimeServicer):
             request_id=request.task_id or "",
             json_mode=json_mode,
             json_schema=schema,
+            # admission priority from the request's intelligence level:
+            # under slot contention, strategic reasoning admits ahead of
+            # bulk operational traffic (FIFO within a level; no wire
+            # change — the level field already rides InferRequest)
+            priority={"strategic": 3, "tactical": 2, "operational": 1}.get(
+                request.intelligence_level.lower(), 0
+            ),
         )
         try:
             try:

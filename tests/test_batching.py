@@ -513,3 +513,83 @@ def test_unload_mid_stream_surfaces_aborted_to_client():
     finally:
         channel.close()
         server.stop(grace=None)
+
+
+def test_priority_admission_order():
+    """Under slot contention, a higher-priority queued request admits
+    before earlier lower-priority ones; FIFO holds within a level."""
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(4), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=1, max_context=128,
+        cache_dtype=jnp.float32,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2)
+    order = []
+    orig_prefill = engine.prefill
+
+    def recording_prefill(slot, ids, **kw):
+        order.append(tuple(ids[:2]))
+        return orig_prefill(slot, ids, **kw)
+
+    engine.prefill = recording_prefill
+    try:
+        import time
+
+        hog = b.submit(Request(prompt_ids=[9, 9], max_tokens=24,
+                               temperature=0.0))
+        deadline = time.time() + 20
+        while b.active_count < 1 and time.time() < deadline:
+            time.sleep(0.01)  # the hog must hold the slot before the rest queue
+        low_a = b.submit(Request(prompt_ids=[1, 1], max_tokens=4,
+                                 temperature=0.0, priority=0))
+        low_b = b.submit(Request(prompt_ids=[1, 2], max_tokens=4,
+                                 temperature=0.0, priority=0))
+        high = b.submit(Request(prompt_ids=[5, 5], max_tokens=4,
+                                temperature=0.0, priority=3))
+        for h in (hog, high, low_a, low_b):
+            h.tokens()
+        assert order == [(9, 9), (5, 5), (1, 1), (1, 2)], order
+        assert b.completed == 4
+    finally:
+        b.shutdown()
+
+
+def test_priority_aging_prevents_starvation():
+    """A long-queued low-priority request outranks a fresh high-priority
+    one once its age boost exceeds the priority gap (admission uses
+    effective priority = priority + age/PRIORITY_AGING_SECS)."""
+    import time as _time
+
+    from aios_tpu.engine import batching as batching_mod
+
+    params = M.init_params(TINY_TEST, jax.random.PRNGKey(5), dtype=jnp.float32)
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=1, max_context=128,
+        cache_dtype=jnp.float32,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2)
+    order = []
+    orig_prefill = engine.prefill
+
+    def recording_prefill(slot, ids, **kw):
+        order.append(tuple(ids[:2]))
+        return orig_prefill(slot, ids, **kw)
+
+    engine.prefill = recording_prefill
+    try:
+        hog = b.submit(Request(prompt_ids=[9, 9], max_tokens=24,
+                               temperature=0.0))
+        deadline = _time.time() + 20
+        while b.active_count < 1 and _time.time() < deadline:
+            _time.sleep(0.01)
+        old_low = b.submit(Request(prompt_ids=[1, 1], max_tokens=4,
+                                   temperature=0.0, priority=0))
+        # age the queued request past the whole priority gap
+        old_low._live.submitted_at -= 4 * batching_mod.PRIORITY_AGING_SECS
+        fresh_high = b.submit(Request(prompt_ids=[5, 5], max_tokens=4,
+                                      temperature=0.0, priority=3))
+        for h in (hog, old_low, fresh_high):
+            h.tokens()
+        assert order == [(9, 9), (1, 1), (5, 5)], order
+    finally:
+        b.shutdown()
