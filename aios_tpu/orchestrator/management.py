@@ -315,6 +315,24 @@ class ManagementConsole:
         await self._broadcast({"event": "goal_submitted", "goal_id": goal.id})
         return web.json_response({"goal_id": goal.id})
 
+    async def _cancel_goal(self, request):
+        goal_id = request.match_info["goal_id"]
+        if goal_id not in self.orch.engine.goals:
+            # a typo'd id is NOT the same as an already-terminal goal
+            return web.json_response(
+                {"cancelled": False, "error": "unknown goal"}, status=404
+            )
+        # same semantics as the CancelGoal RPC: engine cancel + in-flight
+        # AI inference abort
+        ok = self.orch.cancel_goal_by_id(goal_id)
+        if ok:
+            await self._broadcast(
+                {"event": "goal_cancelled", "goal_id": goal_id}
+            )
+        return web.json_response(
+            {"cancelled": ok}, status=200 if ok else 409
+        )
+
     async def _goal_tasks(self, request):
         goal_id = request.match_info["goal_id"]
         tasks = self.orch.engine.tasks_for_goal(goal_id)
@@ -453,6 +471,7 @@ class ManagementConsole:
         app.router.add_get("/api/status", self._status)
         app.router.add_get("/api/goals", self._goals)
         app.router.add_post("/api/goals", self._submit_goal)
+        app.router.add_post("/api/goals/{goal_id}/cancel", self._cancel_goal)
         app.router.add_get("/api/goals/{goal_id}/tasks", self._goal_tasks)
         app.router.add_get("/api/goals/{goal_id}/messages", self._goal_messages)
         app.router.add_post("/api/chat", self._chat)

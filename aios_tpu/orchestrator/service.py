@@ -92,12 +92,17 @@ class OrchestratorService(OrchestratorServicer):
             progress_percent=self.engine.progress(goal.id),
         )
 
-    def CancelGoal(self, request, context):
-        ok = self.engine.cancel_goal(request.id)
+    def cancel_goal_by_id(self, goal_id: str) -> bool:
+        """Shared by the CancelGoal RPC and the console's cancel route:
+        cancel the goal AND abort any in-flight AI inference for it (the
+        loop's between-rounds check only stops future rounds)."""
+        ok = self.engine.cancel_goal(goal_id)
         if ok and self.autonomy is not None:
-            # abort any IN-FLIGHT AI inference for the dead goal now — the
-            # loop's between-rounds check only stops future rounds
-            self.autonomy.notify_goal_cancelled(request.id)
+            self.autonomy.notify_goal_cancelled(goal_id)
+        return ok
+
+    def CancelGoal(self, request, context):
+        ok = self.cancel_goal_by_id(request.id)
         return common_pb2.Status(
             success=ok, message="cancelled" if ok else "not cancellable"
         )

@@ -12,6 +12,7 @@
 #   serving   per-model TPU serving counters (slots, pages, prefix, queue)
 #   goals     recent goals through the console
 #   submit "<text>"   submit a goal
+#   cancel <goal-id>  cancel a goal (also aborts its in-flight AI work)
 #   logs [service]    tail the supervisor's per-service logs
 #   start|stop|restart    systemd unit control (install --systemd first)
 set -euo pipefail
@@ -83,6 +84,10 @@ case "$cmd" in
       -H 'Content-Type: application/json' \
       -d "{\"description\": $(python3 -c 'import json,sys; print(json.dumps(sys.argv[1]))' "$2")}" && echo
     ;;
+  cancel)
+    [[ $# -ge 2 ]] || { echo "usage: aiosctl.sh cancel <goal-id>" >&2; exit 2; }
+    curl -fsS -X POST "$CONSOLE/api/goals/$2/cancel" && echo
+    ;;
   logs)
     svc=${2:-}
     if [[ -d "$LOG_DIR" ]]; then
@@ -106,7 +111,7 @@ case "$cmd" in
     sudo systemctl "$cmd" aios.service
     ;;
   *)
-    echo "unknown command: $cmd (status|health|serving|goals|submit|logs|start|stop|restart)" >&2
+    echo "unknown command: $cmd (status|health|serving|goals|submit|cancel|logs|start|stop|restart)" >&2
     exit 2
     ;;
 esac

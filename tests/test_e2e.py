@@ -275,3 +275,22 @@ def test_aiosctl_smoke_against_live_stack(stack):
     assert health.returncode == 0, health.stdout + health.stderr
     first_line = health.stdout.strip().splitlines()[0]
     assert json.loads(first_line)["healthy"] is True
+
+    # submit + cancel round-trip through the CLI (the operator's kill
+    # switch for a runaway goal rides the console cancel route)
+    submitted = subprocess.run(
+        ["bash", ctl, "submit", "aiosctl cancel-me goal"], env=env,
+        capture_output=True, text=True, timeout=30,
+    )
+    assert submitted.returncode == 0, submitted.stdout + submitted.stderr
+    goal_id = json.loads(submitted.stdout)["goal_id"]
+    cancelled = subprocess.run(
+        ["bash", ctl, "cancel", goal_id], env=env, capture_output=True,
+        text=True, timeout=30,
+    )
+    # the heuristic path may complete tiny goals before the cancel lands;
+    # either way the CLI round-trips and the goal ends terminal
+    if cancelled.returncode == 0:
+        assert json.loads(cancelled.stdout)["cancelled"] is True
+    status2 = stack["orch"].GetGoalStatus(common_pb2.GoalId(id=goal_id))
+    assert status2.goal.status in ("cancelled", "completed", "failed")

@@ -2,6 +2,7 @@
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -185,6 +186,22 @@ def test_console_dashboard_and_api(console):
     assert serving["models"]["tinyllama"]["decode_steps"] == 41
     health2 = _get(console + "/api/health")
     assert health2["services"] == {"runtime": True, "memory": True}
+
+    # operator cancel route: cancels through the same path as the
+    # CancelGoal RPC (in-flight AI abort included); repeat -> 409
+    out2 = _post(console + "/api/chat", {"message": "please cancel me"})
+    cancelled = _post(console + f"/api/goals/{out2['goal_id']}/cancel", {})
+    assert cancelled["cancelled"] is True
+    goals2 = _get(console + "/api/goals")
+    st = {g["id"]: g["status"] for g in goals2["goals"]}
+    assert st[out2["goal_id"]] == "cancelled"
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(console + f"/api/goals/{out2['goal_id']}/cancel", {})
+    assert err.value.code == 409
+    # unknown id is 404, not the already-terminal 409
+    with pytest.raises(urllib.error.HTTPError) as err2:
+        _post(console + "/api/goals/not-a-goal/cancel", {})
+    assert err2.value.code == 404
 
 
 # ---------------------------------------------------------------------------
