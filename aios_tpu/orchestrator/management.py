@@ -91,6 +91,7 @@ DASHBOARD_HTML = """<!doctype html>
   <section><h2>Goals <small>(click for tasks + conversation)</small></h2>
    <div id="goals"></div></section>
   <section id="detail"><h2 id="dtitle">Goal</h2>
+  <button id="cancelbtn" onclick="cancelGoal()">cancel goal</button>
    <div id="dprog" class="bar"><i style="width:0"></i></div>
    <div id="tasks"></div>
    <div id="thread"></div>
@@ -171,6 +172,14 @@ async function openGoal(id){
  if(ws&&ws.readyState===1)
   ws.send(JSON.stringify({action:'subscribe_goal',goal_id:id}));
  await loadDetail(id); refresh();
+}
+
+async function cancelGoal(){
+ if(!selected)return;
+ try{
+  await fetch(`/api/goals/${selected}/cancel`,{method:'POST'});
+ }catch(e){}
+ refresh();
 }
 
 async function loadDetail(id){

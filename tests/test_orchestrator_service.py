@@ -181,6 +181,7 @@ def test_console_dashboard_and_api(console):
     # drill-down + conversation thread + serving/health panels all have a
     # UI path and the new /api/serving route serves the counters
     assert "openGoal" in html and "subscribe_goal" in html
+    assert "cancelGoal" in html  # operator kill switch in the drill-down
     assert "TPU serving" in html and "Service health" in html
     serving = _get(console + "/api/serving")
     assert serving["models"]["tinyllama"]["decode_steps"] == 41
