@@ -177,8 +177,12 @@ async function openGoal(id){
 async function cancelGoal(){
  if(!selected)return;
  try{
-  await fetch(`/api/goals/${selected}/cancel`,{method:'POST'});
- }catch(e){}
+  const r=await fetch(`/api/goals/${selected}/cancel`,{method:'POST'});
+  if(!r.ok){
+   const b=await r.json().catch(()=>({}));
+   $('dtitle').textContent+=` — cancel failed (${b.error||'already terminal'})`;
+  }
+ }catch(e){$('dtitle').textContent+=' — cancel failed (console unreachable)';}
  refresh();
 }
 
