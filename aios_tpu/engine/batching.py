@@ -396,8 +396,15 @@ class ContinuousBatcher:
                 # chunk NOW — deferring to the next tick would let _admit()
                 # hand the freed pages to a new request and force another
                 # eviction. With nobody left to evict the admission itself
-                # is the victim (its partial pages release).
-                if not self._evict_longest(e.replica):
+                # is the victim (its partial pages release); when only
+                # HIGHER-priority streams hold the pool, keep the partial
+                # admission and retry next tick (they drain eventually).
+                outcome = self._evict_longest(
+                    e.replica, requester_priority=live.req.priority
+                )
+                if outcome == "blocked":
+                    return
+                if outcome == "empty":
                     self._prefilling = None
                     self._reserved_slot = -1
                     live.done = True
@@ -501,13 +508,19 @@ class ContinuousBatcher:
             except PoolExhausted as e:
                 with self._qlock:
                     self._waiting.appendleft(live)  # keep FIFO order
-                if not self._evict_longest(e.replica):
+                outcome = self._evict_longest(
+                    e.replica, requester_priority=live.req.priority
+                )
+                if outcome == "empty":
                     # nothing to evict: the prompt is bigger than the whole
                     # pool — fail just this request, not the scheduler
                     with self._qlock:
                         self._waiting.popleft()
                     live.done = True
                     live.out_q.put(_END)
+                # "blocked": the pool is held by strictly higher-priority
+                # streams — the admission stays queued and retries as they
+                # drain; "evicted": retry next pass with the freed pages
                 return
             if live.constraint is not None:
                 first = self._constrained_first(live, first)
@@ -581,34 +594,50 @@ class ContinuousBatcher:
         for live in cancelled:
             self._finish(live, was_cancelled=True)
 
-    def _evict_longest(self, replica: Optional[int] = None) -> bool:
-        """Retire the live request with the most cache rows (frees the most
-        pages) so a pool-exhausted dispatch can make progress. Returns
-        False when there is nothing to evict. ``replica`` restricts the
-        hunt to requests whose slot lives on the starved replica of a
-        dp-partitioned pool — evicting elsewhere frees nothing useful."""
+    def _evict_longest(
+        self, replica: Optional[int] = None,
+        requester_priority: Optional[int] = None,
+    ) -> str:
+        """Retire the lowest-priority live request, longest first within a
+        priority level (frees the most pages), so a pool-exhausted
+        dispatch can make progress without sacrificing strategic work to
+        keep bulk traffic alive. ``replica`` restricts the hunt to the
+        starved replica of a dp-partitioned pool — evicting elsewhere
+        frees nothing useful. ``requester_priority`` (admission paths)
+        refuses to evict a victim that STRICTLY outranks the requester —
+        the admission waits instead.
+
+        Returns "evicted", "empty" (nothing live to evict), or "blocked"
+        (only higher-priority victims exist)."""
         alloc = self.engine.allocator
         with self._lock:
-            victims = sorted(
-                (
-                    l for l in self._live.values()
-                    if replica is None
-                    or alloc.replica_of(l.slot) == replica
+            candidates = [
+                l for l in self._live.values()
+                if replica is None or alloc.replica_of(l.slot) == replica
+            ]
+            if not candidates:
+                return "empty"
+            victim = min(
+                candidates,
+                key=lambda l: (
+                    l.req.priority, -self.engine.slot_length(l.slot)
                 ),
-                key=lambda l: self.engine.slot_length(l.slot),
             )
-        if not victims:
-            return False
-        victim = victims[-1]
+        if (
+            requester_priority is not None
+            and victim.req.priority > requester_priority
+        ):
+            return "blocked"
         log.warning(
-            "KV page pool exhausted; retiring longest request %s "
-            "(%d rows) to free pages",
+            "KV page pool exhausted; retiring lowest-priority longest "
+            "request %s (priority %d, %d rows) to free pages",
             victim.req.request_id,
+            victim.req.priority,
             self.engine.slot_length(victim.slot),
         )
         self.pool_evictions += 1
         self._finish(victim)
-        return True
+        return "evicted"
 
     def _abort_all(self, exc: BaseException) -> None:
         """A scheduler-thread failure must surface, not strand callers: every

@@ -855,3 +855,79 @@ def test_paged_cancel_eviction_prefix_soak(params):
     finally:
         b.shutdown()
         engine.close()
+
+
+def test_eviction_prefers_low_priority_victims(params):
+    """Pool-exhaustion eviction retires the LOWEST-priority live request
+    (longest within a level) — a strategic stream survives while a longer
+    operational one is sacrificed."""
+    import time
+
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=3, max_context=256,
+        cache_dtype=jnp.float32, paged_pool_rows=160, page_size=16,
+        prefix_cache=False,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2)
+    try:
+        # 9 usable pages (1 sacrificial); one low and one high stream
+        # both growing until the pool exhausts
+        low1 = b.submit(Request(prompt_ids=[1] * 40, max_tokens=500,
+                                temperature=0.0, priority=0))
+        high = b.submit(Request(prompt_ids=[2] * 40, max_tokens=500,
+                                temperature=0.0, priority=3))
+        deadline = time.time() + 30
+        while b.active_count < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert b.active_count == 2
+        # both grow until the pool exhausts; eviction must hit the
+        # priority-0 stream even when lengths are close
+        deadline = time.time() + 60
+        while b.pool_evictions < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert b.pool_evictions >= 1
+        low_toks = low1.tokens()
+        high_toks = high.tokens()
+        # the low-priority stream was cut short; the high one ran longer
+        assert len(high_toks) > len(low_toks), (len(high_toks), len(low_toks))
+    finally:
+        b.shutdown()
+        engine.close()
+
+
+def test_low_priority_admission_waits_instead_of_evicting_high(params):
+    """A low-priority admission must NOT evict strictly higher-priority
+    live streams; it waits queued and admits once they drain."""
+    import time
+
+    engine = TPUEngine(
+        TINY_TEST, params, num_slots=2, max_context=256,
+        cache_dtype=jnp.float32, paged_pool_rows=256, page_size=16,
+        prefix_cache=False,
+    )
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2)
+    try:
+        # 15 usable pages; each high peaks at 7 pages (40-row prompt + 60
+        # tokens), so the two FIT together and never self-evict — only
+        # the low admission conflicts
+        highs = [b.submit(Request(prompt_ids=[2 + i] * 40, max_tokens=60,
+                                  temperature=0.0, priority=3))
+                 for i in range(2)]
+        deadline = time.time() + 60
+        while engine.allocator.pages_in_use() < 12 and time.time() < deadline:
+            time.sleep(0.02)  # highs near peak: <= 3 pages free
+        assert engine.allocator.pages_in_use() >= 12
+        # 64-row prompt needs 4 pages > free margin -> PoolExhausted, and
+        # the only victims outrank the requester -> admission must WAIT
+        low = b.submit(Request(prompt_ids=[1] * 64, max_tokens=4,
+                               temperature=0.0, priority=0))
+        high_toks = [h.tokens() for h in highs]
+        # the high streams ran their FULL budgets — never evicted to make
+        # room for the low request
+        assert all(len(t) == 60 for t in high_toks), [len(t) for t in high_toks]
+        low_toks = low.tokens()  # admits after the highs drain
+        assert len(low_toks) == 4
+        assert b.pool_evictions == 0
+    finally:
+        b.shutdown()
+        engine.close()
