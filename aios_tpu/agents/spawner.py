@@ -16,12 +16,13 @@ import subprocess
 import sys
 import threading
 import time
-import tomllib
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional
 
 from . import AGENT_TYPES
+from .._compat import tomllib
+from ..obs import instruments as obs
 
 log = logging.getLogger("aios.spawner")
 
@@ -133,6 +134,7 @@ class AgentSpawner:
                               entry.config.name, MAX_RESTARTS)
                     continue
                 entry.restarts += 1
+                obs.AGENT_RESTARTS.labels(agent=entry.config.name).inc()
                 log.warning("agent %s exited (%s); restart %d/%d",
                             entry.config.name, p.returncode,
                             entry.restarts, MAX_RESTARTS)

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .engine import ChunkedPrefill, TPUEngine
 from .paged import PoolExhausted
+from ..obs import instruments as obs
 
 log = logging.getLogger("aios.batcher")
 
@@ -223,6 +224,34 @@ class ContinuousBatcher:
                             draft_len=self.spec_draft_len,
                             ngram=self.spec_ngram,
                         )
+        # Metric children resolved ONCE (labels() is a locked dict lookup
+        # — fine per request, too slow per decoded token); the queue-depth
+        # gauge pulls live state at scrape time through a weakref so a
+        # shut-down batcher can be collected.
+        import weakref
+
+        model_name = engine.cfg.name
+        self._obs_tokens = obs.ENGINE_TOKENS.labels(model=model_name)
+        self._obs_ttft = obs.ENGINE_TTFT.labels(model=model_name)
+        self._obs_completed = obs.ENGINE_REQUESTS_COMPLETED.labels(
+            model=model_name
+        )
+        self._obs_cancelled = obs.ENGINE_REQUESTS_CANCELLED.labels(
+            model=model_name
+        )
+        self._obs_evictions = obs.ENGINE_POOL_EVICTIONS.labels(
+            model=model_name
+        )
+        self._obs_tps = obs.ENGINE_TOKENS_PER_SECOND.labels(model=model_name)
+        _ref = weakref.ref(self)
+        obs.ENGINE_QUEUE_DEPTH.labels(model=model_name).set_function(
+            lambda: (lambda b: float(b.queue_depth()) if b is not None
+                     else 0.0)(_ref())
+        )
+        # tokens/sec gauge state: emitted tokens over a ~1 s window,
+        # refreshed from the scheduler loop (decays to 0 when idle)
+        self._rate_tokens = 0
+        self._rate_t0 = time.monotonic()
         self._thread = threading.Thread(
             target=self._run, name="continuous-batcher", daemon=True
         )
@@ -367,6 +396,11 @@ class ContinuousBatcher:
                 "requests are NOT terminated (wedged dispatch?)"
             )
             return
+        # the pushed throughput gauge would otherwise freeze at its last
+        # measured rate (ghost tok/s for an unloaded model); zeroed AFTER
+        # the join so a final in-flight _tick can't overwrite it. The pull
+        # gauges (queue depth, occupancy) decay through their weakrefs.
+        self._obs_tps.set(0.0)
         # terminate every outstanding request AFTER the scheduler stopped:
         # nothing will ever deliver their end-of-stream once the thread is
         # gone, so a consumer blocked in out_q.get() — e.g. a StreamInfer
@@ -417,6 +451,7 @@ class ContinuousBatcher:
             if live.constraint is not None:
                 first = self._constrained_first(live, first)
             live.first_token_at = time.monotonic()
+            self._obs_ttft.observe(live.first_token_at - live.submitted_at)
             with self._lock:
                 self._live[live.slot] = live
             self._emit(live, first)
@@ -525,6 +560,7 @@ class ContinuousBatcher:
             if live.constraint is not None:
                 first = self._constrained_first(live, first)
             live.first_token_at = time.monotonic()
+            self._obs_ttft.observe(live.first_token_at - live.submitted_at)
             with self._lock:
                 self._live[slot] = live
             self._emit(live, first)
@@ -546,6 +582,8 @@ class ContinuousBatcher:
         if live.cancelled:
             return  # reaped (slot freed) at the next tick boundary
         live.produced += 1
+        self._obs_tokens.inc()
+        self._rate_tokens += 1
         live.out_q.put(token)
         hit_stop = token in live.req.stop_ids
         out_of_budget = live.produced >= live.req.max_tokens
@@ -562,8 +600,10 @@ class ContinuousBatcher:
         self.engine.release(live.slot)
         if was_cancelled:
             self.cancellations += 1
+            self._obs_cancelled.inc()
         else:
             self.completed += 1
+            self._obs_completed.inc()
         # _END goes last: when a consumer unblocks, all scheduler-side state
         # (slot freed, counters bumped) is already final
         live.out_q.put(_END)
@@ -583,6 +623,7 @@ class ContinuousBatcher:
         for live in dropped:
             live.done = True
             self.cancellations += 1
+            self._obs_cancelled.inc()
             live.out_q.put(_END)
         if self._prefilling is not None and self._prefilling[0].cancelled:
             live = self._prefilling[0]
@@ -636,6 +677,7 @@ class ContinuousBatcher:
             self.engine.slot_length(victim.slot),
         )
         self.pool_evictions += 1
+        self._obs_evictions.inc()
         self._finish(victim)
         return "evicted"
 
@@ -682,6 +724,11 @@ class ContinuousBatcher:
                 self._abort_all(exc)
 
     def _tick(self) -> None:
+        now = time.monotonic()
+        if now - self._rate_t0 >= 1.0:
+            self._obs_tps.set(self._rate_tokens / (now - self._rate_t0))
+            self._rate_tokens = 0
+            self._rate_t0 = now
         self._reap_cancelled()
         self._advance_prefill()
         self._admit()

@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -35,6 +36,7 @@ import numpy as np
 
 from . import model, paged, sampling, spec
 from .config import ModelConfig
+from ..obs import instruments as obs
 
 log = logging.getLogger("aios.engine")
 
@@ -557,6 +559,70 @@ class TPUEngine:
         self.spec_rounds = 0
         self.spec_tokens = 0
         self.spec_slot_rounds = 0
+        # XLA compile-event accounting: every new jit graph counts once
+        # and its FIRST dispatch's wall time — jax compiles synchronously
+        # inside that call — is recorded as the compile stall. stats(),
+        # bench.py, and the aios_tpu_engine_xla_* instruments all read
+        # these, so a mid-serving compile (the TTFT-stall class warmup
+        # exists to prevent) is visible instead of a mystery latency spike.
+        self.compile_events = 0
+        self.compile_seconds = 0.0
+        self._obs_decode_steps = obs.ENGINE_DECODE_STEPS.labels(model=cfg.name)
+        self._register_gauges()
+
+    def _register_gauges(self) -> None:
+        """Scrape-time gauges over live engine state. weakref-bound so a
+        closed engine (close() frees HBM deterministically) can still be
+        garbage-collected; a model reload under the same name re-registers
+        and the stale callback is replaced."""
+        import weakref
+
+        name = self.cfg.name
+        ref = weakref.ref(self)
+
+        def slots() -> float:
+            e = ref()
+            return float(e.active.sum()) if e is not None else 0.0
+
+        def occupancy() -> float:
+            e = ref()
+            if e is None or not e.num_slots:
+                return 0.0
+            return float(e.active.sum()) / e.num_slots
+
+        obs.ENGINE_SLOTS_IN_USE.labels(model=name).set_function(slots)
+        obs.ENGINE_OCCUPANCY.labels(model=name).set_function(occupancy)
+        if self.allocator is not None:
+            def pages_in_use() -> float:
+                e = ref()
+                return float(e.allocator.pages_in_use()) if e is not None else 0.0
+
+            def page_util() -> float:
+                e = ref()
+                if e is None:
+                    return 0.0
+                total = e.allocator.pages_in_use() + e.allocator.free_pages
+                return e.allocator.pages_in_use() / total if total else 0.0
+
+            obs.ENGINE_KV_PAGES_IN_USE.labels(model=name).set_function(
+                pages_in_use
+            )
+            obs.ENGINE_KV_PAGE_UTILIZATION.labels(model=name).set_function(
+                page_util
+            )
+        if self.prefix_index is not None:
+            def hits() -> float:
+                e = ref()
+                ix = e.prefix_index if e is not None else None
+                return float(ix.hits) if ix is not None else 0.0
+
+            def misses() -> float:
+                e = ref()
+                ix = e.prefix_index if e is not None else None
+                return float(ix.misses) if ix is not None else 0.0
+
+            obs.ENGINE_PREFIX_HITS.labels(model=name).set_function(hits)
+            obs.ENGINE_PREFIX_MISSES.labels(model=name).set_function(misses)
 
     # -- jitted cores -------------------------------------------------------
 
@@ -983,6 +1049,31 @@ class TPUEngine:
         new["key"] = key
         return new, first
 
+    def _instrument_compile(self, fn, kind: str):
+        """Count the new jit graph and time its FIRST dispatch (jax traces
+        and XLA-compiles synchronously inside that call; execution itself
+        is async, so the first-call elapsed isolates the compile stall).
+        Subsequent calls go straight through."""
+        obs.ENGINE_XLA_COMPILES.labels(model=self.cfg.name, kind=kind).inc()
+        self.compile_events += 1
+        hist = obs.ENGINE_XLA_COMPILE_SECONDS.labels(
+            model=self.cfg.name, kind=kind
+        )
+        state = {"first": True}
+
+        def wrapper(*args, **kwargs):
+            if not state["first"]:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            state["first"] = False
+            self.compile_seconds += dt
+            hist.observe(dt)
+            return out
+
+        return wrapper
+
     def _step_fn(self, n_steps: int):
         fn = self._step_fns.get(n_steps)
         if fn is None:
@@ -996,6 +1087,7 @@ class TPUEngine:
                     lambda p, s: self._step_impl(p, s, n_steps),
                     donate_argnums=(1,),
                 )
+            fn = self._instrument_compile(fn, "step")
             self._step_fns[n_steps] = fn
         return fn
 
@@ -1014,6 +1106,7 @@ class TPUEngine:
                     lambda p, s, m: self._step_impl(p, s, 1, None, m),
                     donate_argnums=(1,),
                 )
+            fn = self._instrument_compile(fn, "masked")
             self._step_fns["masked"] = fn
         return fn
 
@@ -1021,7 +1114,9 @@ class TPUEngine:
         fn = self._prefill_fns.get(bucket)
         if fn is None:
             impl = self._prefill_impl_paged if self.paged else self._prefill_impl
-            fn = jax.jit(impl, donate_argnums=(1,))
+            fn = self._instrument_compile(
+                jax.jit(impl, donate_argnums=(1,)), "prefill"
+            )
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -1039,6 +1134,7 @@ class TPUEngine:
                     lambda p, s: self._spec_impl(p, s, *key),
                     donate_argnums=(1,),
                 )
+            fn = self._instrument_compile(fn, "spec")
             self._spec_fns[key] = fn
         return fn
 
@@ -1047,7 +1143,9 @@ class TPUEngine:
         fn = self._chunk_fns.get(key)
         if fn is None:
             impl = self._final_chunk_impl if final else self._prefill_chunk_impl
-            fn = jax.jit(impl, donate_argnums=(1,))
+            fn = self._instrument_compile(
+                jax.jit(impl, donate_argnums=(1,)), "chunk"
+            )
             self._chunk_fns[key] = fn
         return fn
 
@@ -1274,6 +1372,7 @@ class TPUEngine:
                     self.params, self.state
                 )
             self.decode_steps += n_steps
+            self._obs_decode_steps.inc(n_steps)
             self._host_lengths = np.minimum(
                 self._host_lengths + n_steps, self.max_context - 1
             )
@@ -1297,6 +1396,7 @@ class TPUEngine:
                     self.params, self.state, m
                 )
             self.decode_steps += 1
+            self._obs_decode_steps.inc()
             self._host_lengths = np.minimum(
                 self._host_lengths + 1, self.max_context - 1
             )
@@ -1361,6 +1461,7 @@ class TPUEngine:
                 n_rounds, draft_len, ngram
             )(self.params, self.state, *args)
             self.decode_steps += n_rounds
+            self._obs_decode_steps.inc(n_rounds)
             counts = np.asarray(counts)
             self.spec_rounds += n_rounds
             self.spec_tokens += int(counts[:, self.active].sum())
@@ -1391,6 +1492,11 @@ class TPUEngine:
         out: Dict[str, float] = {
             "decode_steps": self.decode_steps,
             "active_slots": int(self.active.sum()),
+            "batch_occupancy": round(
+                float(self.active.sum()) / self.num_slots, 3
+            ) if self.num_slots else 0.0,
+            "xla_compiles": self.compile_events,
+            "xla_compile_s": round(self.compile_seconds, 2),
         }
         if self.spec_rounds:
             out["spec_rounds"] = self.spec_rounds

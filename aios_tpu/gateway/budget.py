@@ -15,6 +15,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List
 
+from ..obs import instruments as obs
+
 # (input $/Mtok, output $/Mtok)
 COST_MODEL: Dict[str, tuple] = {
     "claude": (3.0, 15.0),
@@ -105,6 +107,16 @@ class BudgetManager:
         with self._lock:
             self._maybe_reset()
             self._records.append(rec)
+        # registry counters do NOT reset on month rollover (Prometheus
+        # counters are monotonic; dashboards take increase() over windows)
+        if rec.cost_usd:
+            obs.GATEWAY_SPEND.labels(provider=provider).inc(rec.cost_usd)
+        obs.GATEWAY_TOKENS.labels(
+            provider=provider, direction="input"
+        ).inc(input_tokens)
+        obs.GATEWAY_TOKENS.labels(
+            provider=provider, direction="output"
+        ).inc(output_tokens)
         return rec
 
     def warning(self, provider: str) -> str:

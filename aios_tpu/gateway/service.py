@@ -13,6 +13,7 @@ from typing import Optional
 import grpc
 
 from .. import rpc
+from ..obs.http import maybe_start_metrics_server
 from ..proto_gen import api_gateway_pb2 as pb
 from ..proto_gen import common_pb2
 from ..services import GATEWAY, ApiGatewayServicer, service_address
@@ -142,6 +143,7 @@ def serve(
     address: Optional[str] = None,
     router: Optional[RequestRouter] = None,
     block: bool = True,
+    metrics_port: Optional[int] = None,
 ):
     address = address or service_address("gateway")
     server = rpc.create_server()
@@ -149,6 +151,9 @@ def serve(
     rpc.add_to_server(GATEWAY, service, server)
     port = server.add_insecure_port(address)
     server.start()
+    service.metrics_server, service.metrics_port = maybe_start_metrics_server(
+        "gateway", metrics_port, health_fn=lambda: {"service": "gateway"}
+    )
     log.info("ApiGateway listening on %s", address)
     if block:
         server.wait_for_termination()

@@ -413,9 +413,13 @@ def serve(
     address: Optional[str] = None,
     data_dir: Optional[str] = None,
     block: bool = True,
+    metrics_port: Optional[int] = None,
 ):
     """Start the memory service (reference binds 0.0.0.0:50053,
-    memory/src/main.rs:511)."""
+    memory/src/main.rs:511). ``metrics_port`` (or
+    AIOS_MEMORY_METRICS_PORT) also starts /metrics + /healthz."""
+    from ..obs.http import maybe_start_metrics_server
+
     address = address or service_address("memory")
     if data_dir:
         import os
@@ -432,6 +436,9 @@ def serve(
     rpc.add_to_server(MEMORY, service, server)
     port = server.add_insecure_port(address)
     server.start()
+    service.metrics_server, service.metrics_port = maybe_start_metrics_server(
+        "memory", metrics_port, health_fn=lambda: {"service": "memory"}
+    )
     log.info("MemoryService listening on %s", address)
     if block:
         server.wait_for_termination()

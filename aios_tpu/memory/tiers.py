@@ -29,7 +29,17 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import instruments as obs
 from .embeddings import embed, rank
+
+
+def _lookup(tier: str, hit: bool) -> None:
+    """Per-tier hit/miss accounting: a lookup that returns something is a
+    hit, an empty result a miss (the tier-efficiency signal the migration
+    policy and dashboards watch)."""
+    obs.MEMORY_TIER_LOOKUPS.labels(
+        tier=tier, result="hit" if hit else "miss"
+    ).inc()
 
 RING_CAPACITY = 10_000
 WORKING_RETENTION_DAYS = 30
@@ -96,7 +106,9 @@ class OperationalMemory:
 
     def get_metric(self, key: str) -> Optional[Tuple[float, int]]:
         with self._lock:
-            return self._metrics.get(key)
+            value = self._metrics.get(key)
+        _lookup("operational", value is not None)
+        return value
 
     def all_metrics(self) -> Dict[str, Tuple[float, int]]:
         with self._lock:
@@ -312,6 +324,7 @@ class WorkingMemory:
             " ORDER BY success_rate DESC, uses DESC LIMIT 1",
             (f"%{trigger}%", min_success_rate),
         )
+        _lookup("working", bool(rows))
         if not rows:
             return None
         keys = ["id", "trigger", "action", "success_rate", "uses", "last_used",
@@ -356,6 +369,7 @@ class WorkingMemory:
             "SELECT state_json, updated_at FROM agent_state WHERE agent_name=?",
             (name,),
         )
+        _lookup("working", bool(rows))
         return (rows[0][0], rows[0][1]) if rows else None
 
     def retention_sweep(self, days: int = WORKING_RETENTION_DAYS) -> None:
@@ -473,6 +487,7 @@ class LongTermMemory:
                     "relevance": score,
                 }
             )
+        _lookup("longterm", bool(out))
         return out
 
     def store_procedure(self, p: Dict[str, Any]) -> None:
@@ -569,4 +584,5 @@ class LongTermMemory:
                     "relevance": score,
                 }
             )
+        _lookup("knowledge", bool(out))
         return out

@@ -1,0 +1,42 @@
+"""Unified observability substrate: metrics + tracing for every layer.
+
+The serving literature (SGLang, RTP-LLM — PAPERS.md) treats first-class
+runtime metrics as the prerequisite for scheduling/batching work; this
+package is that substrate for the aiOS-TPU stack:
+
+  * ``obs.metrics``     — thread-safe Prometheus-style registry
+                          (Counter / Gauge / Histogram with labels, text
+                          exposition, process-wide default registry);
+  * ``obs.instruments`` — the ONE catalog of every metric the stack
+                          registers (docs/OBSERVABILITY.md mirrors it);
+  * ``obs.tracing``     — span-based tracing with W3C ``traceparent``
+                          context propagation (goal -> task -> agent ->
+                          RPC -> decode hierarchy);
+  * ``obs.interceptors``— gRPC client/server interceptors wiring every
+                          RPC into rpc_{requests,errors,latency} metrics
+                          and the span tree (installed by aios_tpu.rpc);
+  * ``obs.http``        — stdlib /metrics + /healthz endpoint each
+                          service's serve() can start.
+
+No third-party dependencies: prometheus_client is not in the image, so
+the registry is self-contained stdlib code.
+"""
+
+from __future__ import annotations
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from .tracing import (  # noqa: F401
+    Span,
+    current_span,
+    current_traceparent,
+    parse_traceparent,
+    recent_spans,
+    start_span,
+)
+from .http import start_metrics_server, maybe_start_metrics_server  # noqa: F401

@@ -1,0 +1,105 @@
+"""Stdlib /metrics + /healthz endpoint for every service.
+
+Each service's ``serve()`` can start one next to its gRPC port — either
+by passing ``metrics_port`` explicitly or via the per-service env var
+``AIOS_<SERVICE>_METRICS_PORT`` (0 = ephemeral port, useful in tests);
+``AIOS_METRICS_HOST`` widens the bind beyond the 127.0.0.1 default for
+external scrapers.
+A Prometheus scrape of ``/metrics`` sees the process-wide default
+registry; ``/healthz`` answers a JSON liveness probe (optionally backed
+by a service-supplied callable).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+from .metrics import REGISTRY, MetricsRegistry
+
+log = logging.getLogger("aios.obs")
+
+
+def start_metrics_server(
+    port: int = 0,
+    host: str = "127.0.0.1",
+    registry: Optional[MetricsRegistry] = None,
+    health_fn: Optional[Callable[[], dict]] = None,
+) -> Tuple[ThreadingHTTPServer, int]:
+    """Start the exposition endpoint on a daemon thread; returns
+    (server, bound_port). ``server.shutdown()`` stops it."""
+    reg = registry or REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path.split("?")[0] == "/metrics":
+                body = reg.render().encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/healthz":
+                payload = {"status": "ok"}
+                if health_fn is not None:
+                    try:
+                        payload.update(health_fn())
+                    except Exception as exc:  # noqa: BLE001
+                        payload = {"status": "degraded", "error": repr(exc)[:200]}
+                body = json.dumps(payload).encode("utf-8")
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(
+        target=server.serve_forever, name="obs-metrics-http", daemon=True
+    )
+    thread.start()
+    bound = server.server_address[1]
+    log.info("metrics endpoint on http://%s:%d/metrics", host, bound)
+    return server, bound
+
+
+def maybe_start_metrics_server(
+    service_name: str,
+    metrics_port: Optional[int] = None,
+    health_fn: Optional[Callable[[], dict]] = None,
+) -> Tuple[Optional[ThreadingHTTPServer], Optional[int]]:
+    """serve()-helper: start the endpoint when asked for explicitly or via
+    ``AIOS_<SERVICE>_METRICS_PORT``; (None, None) otherwise."""
+    host = os.environ.get("AIOS_METRICS_HOST", "127.0.0.1")
+    if metrics_port is None:
+        env = os.environ.get(f"AIOS_{service_name.upper()}_METRICS_PORT")
+        if env is None or env == "":
+            return None, None
+        try:
+            metrics_port = int(env)
+        except ValueError:
+            log.warning(
+                "AIOS_%s_METRICS_PORT=%r is not an integer; metrics "
+                "endpoint disabled", service_name.upper(), env,
+            )
+            return None, None
+    try:
+        return start_metrics_server(
+            port=metrics_port, host=host, health_fn=health_fn
+        )
+    except (OSError, OverflowError) as exc:  # taken port / port > 65535
+        # the endpoint is optional: a taken/invalid port must not crash a
+        # serve() whose gRPC server is already up
+        log.warning(
+            "%s metrics endpoint on port %s failed (%s); continuing "
+            "without it", service_name, metrics_port, exc,
+        )
+        return None, None

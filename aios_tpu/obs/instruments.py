@@ -1,0 +1,208 @@
+"""The metric catalog: every instrument the stack registers, in one place.
+
+Naming convention (enforced by tests/test_obs_lint.py):
+  * prefix ``aios_tpu_``, snake_case ``[a-z0-9_]`` only;
+  * unit suffix from the approved set: ``_seconds``, ``_bytes``,
+    ``_total`` (counts and count-valued gauges), ``_ratio``,
+    ``_per_second``, ``_usd_total`` (spend counters end in ``_total``
+    with the currency inline).
+
+Keeping every definition here (rather than scattered at point of use)
+makes drift visible in review, keeps duplicate-registration impossible,
+and gives the lint test one import to check. Hot paths resolve label
+children once and hold them (see ContinuousBatcher) — ``labels()`` is a
+dict lookup under a lock, fine for RPC rates, too slow per decoded token.
+
+docs/OBSERVABILITY.md mirrors this catalog; update both together.
+"""
+
+from __future__ import annotations
+
+from .metrics import Counter, Gauge, Histogram
+
+# -- RPC layer (client + server interceptors, aios_tpu/rpc.py) -------------
+
+RPC_REQUESTS = Counter(
+    "aios_tpu_rpc_requests_total",
+    "RPCs started, by side (client|server), service, and method",
+    ("side", "service", "method"),
+)
+RPC_ERRORS = Counter(
+    "aios_tpu_rpc_errors_total",
+    "RPCs finished non-OK, by side, service, method, and status code",
+    ("side", "service", "method", "code"),
+)
+RPC_LATENCY = Histogram(
+    "aios_tpu_rpc_latency_seconds",
+    "RPC wall time start->termination (streams: until exhausted)",
+    ("side", "service", "method"),
+)
+
+# -- engine: decode loop + continuous batcher ------------------------------
+
+ENGINE_DECODE_STEPS = Counter(
+    "aios_tpu_engine_decode_steps_total",
+    "Decode steps executed (each advances every active slot one token)",
+    ("model",),
+)
+ENGINE_TOKENS = Counter(
+    "aios_tpu_engine_generated_tokens_total",
+    "Tokens emitted to request streams by the continuous batcher",
+    ("model",),
+)
+ENGINE_TOKENS_PER_SECOND = Gauge(
+    "aios_tpu_engine_tokens_per_second",
+    "Recent decode throughput per model (tokens/sec/chip, ~1 s window)",
+    ("model",),
+)
+ENGINE_TTFT = Histogram(
+    "aios_tpu_engine_ttft_seconds",
+    "Submission -> first sampled token through the continuous batcher",
+    ("model",),
+)
+ENGINE_OCCUPANCY = Gauge(
+    "aios_tpu_engine_batch_occupancy_ratio",
+    "Active decode slots / total slots (scrape-time)",
+    ("model",),
+)
+ENGINE_SLOTS_IN_USE = Gauge(
+    "aios_tpu_engine_slots_in_use_total",
+    "Active decode slots (scrape-time)",
+    ("model",),
+)
+ENGINE_QUEUE_DEPTH = Gauge(
+    "aios_tpu_engine_queue_depth_total",
+    "Requests waiting for a slot (admission backlog, scrape-time)",
+    ("model",),
+)
+ENGINE_KV_PAGES_IN_USE = Gauge(
+    "aios_tpu_engine_kv_pages_in_use_total",
+    "Paged-KV physical pages currently mapped (scrape-time)",
+    ("model",),
+)
+ENGINE_KV_PAGE_UTILIZATION = Gauge(
+    "aios_tpu_engine_kv_page_utilization_ratio",
+    "Paged-KV pages in use / pool capacity (scrape-time)",
+    ("model",),
+)
+ENGINE_PREFIX_HITS = Gauge(
+    "aios_tpu_engine_prefix_cache_hits_total",
+    "Prompt-prefix cache hits (monotonic, read from the prefix index)",
+    ("model",),
+)
+ENGINE_PREFIX_MISSES = Gauge(
+    "aios_tpu_engine_prefix_cache_misses_total",
+    "Prompt-prefix cache misses (monotonic, read from the prefix index)",
+    ("model",),
+)
+ENGINE_REQUESTS_COMPLETED = Counter(
+    "aios_tpu_engine_requests_completed_total",
+    "Requests retired normally (EOS / max_tokens / full cache)",
+    ("model",),
+)
+ENGINE_REQUESTS_CANCELLED = Counter(
+    "aios_tpu_engine_requests_cancelled_total",
+    "Requests cancelled by the caller (gRPC disconnect, unload)",
+    ("model",),
+)
+ENGINE_POOL_EVICTIONS = Counter(
+    "aios_tpu_engine_pool_evictions_total",
+    "Live requests retired to free KV pages under pool exhaustion",
+    ("model",),
+)
+ENGINE_XLA_COMPILES = Counter(
+    "aios_tpu_engine_xla_compiles_total",
+    "XLA graph builds by kind (step|masked|prefill|chunk|spec|hist)",
+    ("model", "kind"),
+)
+ENGINE_XLA_COMPILE_SECONDS = Histogram(
+    "aios_tpu_engine_xla_compile_seconds",
+    "First-dispatch wall time of each new XLA graph (trace+compile stall)",
+    ("model", "kind"),
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0),
+)
+
+# -- runtime service -------------------------------------------------------
+
+RUNTIME_INFER_LATENCY = Histogram(
+    "aios_tpu_runtime_infer_latency_seconds",
+    "Per-model inference RPC wall time (rpc = Infer|StreamInfer)",
+    ("model", "rpc"),
+)
+RUNTIME_STREAM_CHUNKS = Counter(
+    "aios_tpu_runtime_stream_chunks_total",
+    "Text chunks emitted by StreamInfer",
+    ("model",),
+)
+RUNTIME_MODELS_READY = Gauge(
+    "aios_tpu_runtime_models_ready_total",
+    "Models in the ready state (scrape-time)",
+)
+
+# -- orchestrator ----------------------------------------------------------
+
+GOAL_TASKS = Counter(
+    "aios_tpu_goal_tasks_total",
+    "Task outcomes recorded by the result aggregator (outcome=success|failure)",
+    ("outcome",),
+)
+GOAL_TASK_TOKENS = Counter(
+    "aios_tpu_goal_task_tokens_total",
+    "Model tokens consumed by recorded task outcomes",
+)
+GOAL_TASK_DURATION = Histogram(
+    "aios_tpu_goal_task_duration_seconds",
+    "Wall time of recorded task outcomes",
+)
+DECISIONS = Counter(
+    "aios_tpu_decisions_total",
+    "Decisions logged, by intelligence level",
+    ("level",),
+)
+SCHEDULER_FIRED = Counter(
+    "aios_tpu_scheduler_fired_total",
+    "Cron schedules fired into goal submission",
+)
+ROUTER_TASKS = Counter(
+    "aios_tpu_router_tasks_total",
+    "Task routing outcomes (outcome=routed|ai_path|no_capable_agent)",
+    ("outcome",),
+)
+
+# -- agents ----------------------------------------------------------------
+
+AGENT_RESTARTS = Counter(
+    "aios_tpu_agent_restarts_total",
+    "Agent child-process restarts by the spawner",
+    ("agent",),
+)
+
+# -- api gateway -----------------------------------------------------------
+
+GATEWAY_SPEND = Counter(
+    "aios_tpu_gateway_spend_usd_total",
+    "Cloud spend recorded against provider budgets (USD)",
+    ("provider",),
+)
+GATEWAY_TOKENS = Counter(
+    "aios_tpu_gateway_tokens_total",
+    "Cloud tokens by provider and direction (input|output)",
+    ("provider", "direction"),
+)
+
+# -- memory tiers ----------------------------------------------------------
+
+MEMORY_TIER_LOOKUPS = Counter(
+    "aios_tpu_memory_tier_lookups_total",
+    "Tier lookups (tier=operational|working|longterm|knowledge, "
+    "result=hit|miss)",
+    ("tier", "result"),
+)
+
+# -- tools -----------------------------------------------------------------
+
+TOOL_INVOCATIONS = Counter(
+    "aios_tpu_tool_invocations_total",
+    "Tool executions recorded in the audit ledger (outcome=success|failure)",
+    ("tool", "outcome"),
+)

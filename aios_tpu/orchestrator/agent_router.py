@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..obs import instruments as obs
 from .goal_engine import Task
 
 HEARTBEAT_TIMEOUT = 15.0
@@ -109,6 +110,7 @@ class AgentRouter:
         loop handles those, agent_router.rs:91-95).
         """
         if not task.required_tools:
+            obs.ROUTER_TASKS.labels(outcome="ai_path").inc()
             return None
         with self._lock:
             capable = [
@@ -118,6 +120,7 @@ class AgentRouter:
                 and all(ns in a.tool_namespaces for ns in task.required_tools)
             ]
             if not capable:
+                obs.ROUTER_TASKS.labels(outcome="no_capable_agent").inc()
                 return None
             # idle first, then most experienced (agent_router.rs:120-141)
             capable.sort(
@@ -127,6 +130,7 @@ class AgentRouter:
             self._assigned.setdefault(chosen.agent_id, []).append(task)
             chosen.status = "busy"
             chosen.current_task_id = task.id
+            obs.ROUTER_TASKS.labels(outcome="routed").inc()
             return chosen.agent_id
 
     def next_task_for(self, agent_id: str) -> Optional[Task]:

@@ -15,6 +15,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from ..obs import instruments as obs
+
 
 def _field_matches(spec: str, value: int) -> bool:
     if spec == "*":
@@ -132,6 +134,7 @@ class GoalScheduler:
                 continue
             if matches_cron(entry.cron_expr, t):
                 self.submit_goal(entry.goal_template, entry.priority)
+                obs.SCHEDULER_FIRED.inc()
                 with self._lock:
                     self._conn.execute(
                         "UPDATE schedules SET last_run=? WHERE id=?",

@@ -20,6 +20,7 @@ from typing import Optional
 import psutil
 
 from .. import rpc
+from ..obs.http import maybe_start_metrics_server
 from ..proto_gen import common_pb2, orchestrator_pb2
 from ..services import ORCHESTRATOR, OrchestratorServicer, service_address
 from .agent_router import AgentRouter, TrackedAgent
@@ -347,15 +348,22 @@ def serve(
     address: Optional[str] = None,
     service: Optional[OrchestratorService] = None,
     block: bool = True,
+    metrics_port: Optional[int] = None,
 ):
     """Start the orchestrator server (reference binds 0.0.0.0:50051,
-    main.rs:791)."""
+    main.rs:791). ``metrics_port`` (or AIOS_ORCHESTRATOR_METRICS_PORT)
+    also starts the /metrics + /healthz endpoint."""
     address = address or service_address("orchestrator")
     server = rpc.create_server(max_workers=32)
     service = service or OrchestratorService()
     rpc.add_to_server(ORCHESTRATOR, service, server)
     port = server.add_insecure_port(address)
     server.start()
+    service.metrics_server, service.metrics_port = maybe_start_metrics_server(
+        "orchestrator",
+        metrics_port,
+        health_fn=lambda: {"service": "orchestrator"},
+    )
     log.info("Orchestrator listening on %s", address)
     if block:
         server.wait_for_termination()

@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..obs import instruments as obs
+
 
 @dataclass
 class TaskOutcome:
@@ -48,6 +50,14 @@ class ResultAggregator:
     def record(self, goal_id: str, outcome: TaskOutcome) -> None:
         with self._lock:
             self._by_goal.setdefault(goal_id, []).append(outcome)
+        # the same numbers the per-goal summary() aggregates, exported
+        # through the process registry (no parallel telemetry path)
+        obs.GOAL_TASKS.labels(
+            outcome="success" if outcome.success else "failure"
+        ).inc()
+        if outcome.tokens_used:
+            obs.GOAL_TASK_TOKENS.inc(outcome.tokens_used)
+        obs.GOAL_TASK_DURATION.observe(outcome.duration_ms / 1000.0)
 
     def summary(self, goal_id: str) -> GoalSummary:
         with self._lock:
@@ -83,6 +93,9 @@ class DecisionLogger:
     def log(self, decision: Decision) -> None:
         with self._lock:
             self._ring.append(decision)
+        obs.DECISIONS.labels(
+            level=decision.intelligence_level or "unknown"
+        ).inc()
 
     def recent(self, limit: int = 50) -> List[Decision]:
         with self._lock:

@@ -19,10 +19,18 @@ of agent-core/proto (SURVEY.md section 1, "IPC protos" row).
 from __future__ import annotations
 
 import concurrent.futures
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Tuple
 
 import grpc
+
+
+def _obs_enabled() -> bool:
+    """Observability interceptors are on by default on every server and
+    channel this module builds; AIOS_OBS_DISABLED=1 opts out (perf A/B,
+    debugging the interceptors themselves)."""
+    return os.environ.get("AIOS_OBS_DISABLED", "") not in ("1", "true", "on")
 
 
 @dataclass(frozen=True)
@@ -110,7 +118,8 @@ def add_to_server(spec: ServiceSpec, servicer: Any, server: grpc.Server) -> None
 def create_server(
     max_workers: int = 16, options: Tuple[Tuple[str, Any], ...] | None = None
 ) -> grpc.Server:
-    """A threaded gRPC server with aiOS-standard channel options."""
+    """A threaded gRPC server with aiOS-standard channel options and the
+    observability interceptors (per-RPC span + rpc_* metrics)."""
     opts = list(
         options
         or (
@@ -118,16 +127,28 @@ def create_server(
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
         )
     )
+    interceptors: Tuple[Any, ...] = ()
+    if _obs_enabled():
+        from .obs.interceptors import server_interceptors
+
+        interceptors = server_interceptors()
     return grpc.server(
-        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=opts,
+        interceptors=interceptors,
     )
 
 
 def insecure_channel(address: str) -> grpc.Channel:
-    return grpc.insecure_channel(
+    channel = grpc.insecure_channel(
         address,
         options=[
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
         ],
     )
+    if _obs_enabled():
+        from .obs.interceptors import intercept_client_channel
+
+        channel = intercept_client_channel(channel)
+    return channel

@@ -24,6 +24,8 @@ from typing import Iterator, Optional
 import grpc
 
 from .. import rpc
+from ..obs import instruments as obs, tracing
+from ..obs.http import maybe_start_metrics_server
 from ..proto_gen import common_pb2, runtime_pb2
 from ..services import RUNTIME, AIRuntimeServicer, service_address
 from ..engine.batching import Request
@@ -55,6 +57,15 @@ class RuntimeService(AIRuntimeServicer):
     def __init__(self, manager: Optional[ModelManager] = None):
         self.manager = manager or ModelManager()
         self.started_at = time.time()
+        # weakref: the process-global gauge must not pin a discarded
+        # manager (and its loaded engines' HBM/caches) for process life
+        import weakref
+
+        ref = weakref.ref(self.manager)
+        obs.RUNTIME_MODELS_READY.set_function(
+            lambda: (lambda m: float(len(m.ready_models())) if m is not None
+                     else 0.0)(ref())
+        )
 
     # -- lifecycle RPCs -----------------------------------------------------
 
@@ -124,7 +135,17 @@ class RuntimeService(AIRuntimeServicer):
         if m is None:
             return runtime_pb2.InferResponse()
         handle, n_prompt = self._submit(m, request, context=context)
-        token_ids = [t for t in handle if t != m.tokenizer.eos_id]
+        # decode span: child of the interceptor's RPC server span (same
+        # handler thread), the leaf of the goal->task->agent->RPC->decode
+        # hierarchy
+        with tracing.start_span(
+            "runtime.decode", model=m.name, rpc="Infer"
+        ) as span:
+            token_ids = [t for t in handle if t != m.tokenizer.eos_id]
+            span.set_attribute("tokens", len(token_ids))
+        obs.RUNTIME_INFER_LATENCY.labels(model=m.name, rpc="Infer").observe(
+            time.time() - t0
+        )
         if handle.aborted:
             # mid-request abort (model unload, scheduler failure): the
             # collected tokens are a truncation — error out, don't present
@@ -143,28 +164,38 @@ class RuntimeService(AIRuntimeServicer):
         )
 
     def StreamInfer(self, request, context) -> Iterator[runtime_pb2.InferChunk]:
+        t0 = time.time()
         m = self._resolve_model(request, context)
         if m is None:
             return
         handle, _ = self._submit(
             m, request, streaming=True, context=context
         )
+        chunk_counter = obs.RUNTIME_STREAM_CHUNKS.labels(model=m.name)
         emitted = ""
         ids = []
         try:
-            for tok in handle:
-                if tok == m.tokenizer.eos_id:
-                    break
-                ids.append(tok)
-                # incremental detokenization: emit the stable text delta
-                text = m.tokenizer.decode(ids)
-                if text.startswith(emitted):
-                    delta = text[len(emitted) :]
-                else:  # rare resegmentation: resend from scratch marker
-                    delta = text
-                if delta:
-                    emitted = text
-                    yield runtime_pb2.InferChunk(text=delta, done=False)
+            with tracing.start_span(
+                "runtime.decode", model=m.name, rpc="StreamInfer"
+            ) as span:
+                for tok in handle:
+                    if tok == m.tokenizer.eos_id:
+                        break
+                    ids.append(tok)
+                    # incremental detokenization: emit the stable text delta
+                    text = m.tokenizer.decode(ids)
+                    if text.startswith(emitted):
+                        delta = text[len(emitted) :]
+                    else:  # rare resegmentation: resend from scratch marker
+                        delta = text
+                    if delta:
+                        emitted = text
+                        chunk_counter.inc()
+                        yield runtime_pb2.InferChunk(text=delta, done=False)
+                span.set_attribute("tokens", len(ids))
+            obs.RUNTIME_INFER_LATENCY.labels(
+                model=m.name, rpc="StreamInfer"
+            ).observe(time.time() - t0)
             if handle.aborted:
                 # ABORTED status instead of a done-chunk: the client must
                 # not mistake a mid-stream unload for a short completion
@@ -326,15 +357,26 @@ def serve(
     address: Optional[str] = None,
     manager: Optional[ModelManager] = None,
     block: bool = True,
+    metrics_port: Optional[int] = None,
 ):
     """Start the runtime gRPC server (reference binds [::]:50055,
-    runtime/src/main.rs:140)."""
+    runtime/src/main.rs:140). ``metrics_port`` (or
+    AIOS_RUNTIME_METRICS_PORT) also starts the /metrics + /healthz
+    endpoint; its server and bound port ride on the service object."""
     address = address or service_address("runtime")
     server = rpc.create_server()
     service = RuntimeService(manager)
     rpc.add_to_server(RUNTIME, service, server)
     port = server.add_insecure_port(address)
     server.start()
+    service.metrics_server, service.metrics_port = maybe_start_metrics_server(
+        "runtime",
+        metrics_port,
+        health_fn=lambda: {
+            "service": "runtime",
+            "models_ready": len(service.manager.ready_models()),
+        },
+    )
     log.info("AIRuntime listening on %s", address)
     if block:
         server.wait_for_termination()

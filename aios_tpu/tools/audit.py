@@ -15,6 +15,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs import instruments as obs
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS audit_log (
     seq INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -82,7 +84,12 @@ class AuditLog:
                  int(success), reason, prev_hash, h),
             )
             self._conn.commit()
-            return rec_id
+        # every tool execution flows through this ledger (executor records
+        # success and failure alike), so this is THE invocation counter
+        obs.TOOL_INVOCATIONS.labels(
+            tool=tool_name, outcome="success" if success else "failure"
+        ).inc()
+        return rec_id
 
     def verify_chain(self) -> Tuple[bool, Optional[int]]:
         """Recompute the whole chain; returns (ok, first_bad_seq)."""

@@ -107,13 +107,24 @@ def serve(
     address: Optional[str] = None,
     executor: Optional[ToolExecutor] = None,
     block: bool = True,
+    metrics_port: Optional[int] = None,
 ):
+    from ..obs.http import maybe_start_metrics_server
+
     address = address or service_address("tools")
     server = rpc.create_server()
     service = ToolRegistryService(executor)
     rpc.add_to_server(TOOLS, service, server)
     port = server.add_insecure_port(address)
     server.start()
+    service.metrics_server, service.metrics_port = maybe_start_metrics_server(
+        "tools",
+        metrics_port,
+        health_fn=lambda: {
+            "service": "tools",
+            "tools": len(service.executor.registry),
+        },
+    )
     log.info("ToolRegistry listening on %s (%d tools)",
              address, len(service.executor.registry))
     if block:

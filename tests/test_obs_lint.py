@@ -1,0 +1,79 @@
+"""Metric-name lint: every instrument in the catalog follows the naming
+convention, so future PRs adding instruments can't drift.
+
+Rules (docs/OBSERVABILITY.md "naming"):
+  * prefix ``aios_tpu_``, snake_case ``[a-z0-9_]`` only;
+  * a unit suffix from the approved set — ``_seconds``, ``_bytes``,
+    ``_total`` (primary trio), plus ``_ratio`` and ``_per_second`` for
+    unitless/rate gauges;
+  * label names snake_case, bounded per-metric label count;
+  * non-empty help text.
+"""
+
+import re
+
+import aios_tpu.obs.instruments  # noqa: F401 - registers the catalog
+from aios_tpu.obs.metrics import REGISTRY
+
+NAME_RE = re.compile(r"^aios_tpu_[a-z0-9_]+$")
+LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_per_second")
+
+
+def _catalog():
+    metrics = [
+        m for m in REGISTRY.collect() if m.name.startswith("aios_tpu_")
+    ]
+    assert metrics, "instrument catalog registered nothing"
+    return metrics
+
+
+def test_metric_names_are_prefixed_snake_case():
+    for m in _catalog():
+        assert NAME_RE.match(m.name), (
+            f"{m.name}: must match aios_tpu_[a-z0-9_]+ (snake_case)"
+        )
+
+
+def test_metric_names_carry_a_unit_suffix():
+    for m in _catalog():
+        assert m.name.endswith(UNIT_SUFFIXES), (
+            f"{m.name}: metric names end in a unit suffix "
+            f"{UNIT_SUFFIXES} (add the unit, or extend the approved set "
+            f"in docs/OBSERVABILITY.md AND here with a reviewed rationale)"
+        )
+
+
+def test_histograms_are_timed_in_seconds():
+    for m in _catalog():
+        if m.kind == "histogram":
+            assert m.name.endswith("_seconds"), (
+                f"{m.name}: histograms in this codebase measure durations; "
+                f"use base-unit seconds"
+            )
+
+
+def test_counters_end_in_total():
+    for m in _catalog():
+        if m.kind == "counter":
+            assert m.name.endswith("_total"), (
+                f"{m.name}: counters use the _total suffix"
+            )
+
+
+def test_label_names_snake_case_and_bounded():
+    for m in _catalog():
+        assert len(m.labelnames) <= 4, (
+            f"{m.name}: {len(m.labelnames)} labels — cardinality budget is "
+            f"4; aggregate instead"
+        )
+        for ln in m.labelnames:
+            assert LABEL_RE.match(ln), f"{m.name}: bad label name {ln!r}"
+            assert ln not in ("le", "overflow"), (
+                f"{m.name}: label {ln!r} collides with reserved names"
+            )
+
+
+def test_help_text_present():
+    for m in _catalog():
+        assert m.help.strip(), f"{m.name}: empty help text"

@@ -1,0 +1,155 @@
+"""Unit tests for the obs metrics registry: counter/gauge/histogram
+semantics, label handling and cardinality, concurrent increments, and the
+Prometheus text exposition format."""
+
+import math
+import threading
+
+import pytest
+
+from aios_tpu.obs import metrics as M
+
+
+@pytest.fixture()
+def reg():
+    return M.MetricsRegistry()
+
+
+def test_counter_semantics(reg):
+    c = M.Counter("aios_tpu_x_total", "h", registry=reg)
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_labeled_counter_children_are_independent(reg):
+    c = M.Counter("aios_tpu_x_total", "h", ("model",), registry=reg)
+    c.labels(model="a").inc()
+    c.labels(model="b").inc(4)
+    assert reg.sample("aios_tpu_x_total", {"model": "a"}) == 1
+    assert reg.sample("aios_tpu_x_total", {"model": "b"}) == 4
+    assert c.value == 5  # family total sums children
+
+
+def test_label_names_must_match(reg):
+    c = M.Counter("aios_tpu_x_total", "h", ("model",), registry=reg)
+    with pytest.raises(ValueError):
+        c.labels(wrong="a")
+    with pytest.raises(ValueError):
+        c.labels()  # missing
+    with pytest.raises(ValueError):
+        c.labels(model="a", extra="b")
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family has no unlabeled series
+
+
+def test_gauge_set_inc_dec_and_callback(reg):
+    g = M.Gauge("aios_tpu_g_total", "h", registry=reg)
+    g.set(10)
+    g.inc(2)
+    g.dec(0.5)
+    assert g.value == 11.5
+    state = {"v": 3}
+    g.set_function(lambda: state["v"])
+    assert g.value == 3
+    state["v"] = 9
+    assert g.value == 9  # read at scrape time, not registration time
+    g.set(1)  # an explicit set clears the callback
+    assert g.value == 1
+
+
+def test_gauge_callback_exception_degrades_to_nan(reg):
+    g = M.Gauge("aios_tpu_g_total", "h", registry=reg)
+    g.set_function(lambda: 1 / 0)
+    assert math.isnan(g.value)  # a broken callback must not kill a scrape
+    assert "aios_tpu_g_total" in reg.render()
+
+
+def test_histogram_buckets_cumulative_sum_count(reg):
+    h = M.Histogram(
+        "aios_tpu_h_seconds", "h", buckets=(0.1, 1.0, 10.0), registry=reg
+    )
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.render()
+    assert 'aios_tpu_h_seconds_bucket{le="0.1"} 1' in text
+    assert 'aios_tpu_h_seconds_bucket{le="1"} 3' in text
+    assert 'aios_tpu_h_seconds_bucket{le="10"} 4' in text
+    assert 'aios_tpu_h_seconds_bucket{le="+Inf"} 5' in text
+    assert "aios_tpu_h_seconds_count 5" in text
+    assert "aios_tpu_h_seconds_sum 56.05" in text
+
+
+def test_histogram_labeled_child(reg):
+    h = M.Histogram(
+        "aios_tpu_h_seconds", "h", ("m",), buckets=(1.0,), registry=reg
+    )
+    h.labels(m="x").observe(0.5)
+    assert h.labels(m="x").sample_count == 1
+    with pytest.raises(ValueError):
+        h.observe(0.5)  # labeled family needs .labels()
+
+
+def test_metric_name_validation(reg):
+    with pytest.raises(ValueError):
+        M.Counter("Bad-Name", "h", registry=reg)
+    with pytest.raises(ValueError):
+        M.Counter("aios_tpu_ok_total", "h", ("Bad-Label",), registry=reg)
+
+
+def test_duplicate_registration_rejected(reg):
+    M.Counter("aios_tpu_x_total", "h", registry=reg)
+    with pytest.raises(ValueError):
+        M.Counter("aios_tpu_x_total", "h", registry=reg)
+
+
+def test_concurrent_increments_are_exact(reg):
+    c = M.Counter("aios_tpu_x_total", "h", ("t",), registry=reg)
+    child = c.labels(t="shared")
+    n_threads, per_thread = 8, 5000
+
+    def worker():
+        for _ in range(per_thread):
+            child.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert child.value == n_threads * per_thread
+
+
+def test_label_cardinality_capped(reg):
+    c = M.Counter("aios_tpu_x_total", "h", ("k",), registry=reg)
+    for i in range(M.MAX_CHILDREN + 10):
+        c.labels(k=f"v{i}").inc()
+    assert len(c._children) <= M.MAX_CHILDREN + 1  # + the overflow child
+    assert c.overflows == 10
+    assert 'overflow="true"' in reg.render()
+    assert c.value == M.MAX_CHILDREN + 10  # nothing dropped, just collapsed
+
+
+def test_exposition_escapes_label_values(reg):
+    c = M.Counter("aios_tpu_x_total", "h", ("p",), registry=reg)
+    c.labels(p='a"b\\c\nd').inc()
+    text = reg.render()
+    assert r'p="a\"b\\c\nd"' in text
+
+
+def test_exposition_help_and_type_lines(reg):
+    M.Counter("aios_tpu_c_total", "counts things", registry=reg)
+    M.Gauge("aios_tpu_g_ratio", "gauges things", registry=reg)
+    text = reg.render()
+    assert "# HELP aios_tpu_c_total counts things" in text
+    assert "# TYPE aios_tpu_c_total counter" in text
+    assert "# TYPE aios_tpu_g_ratio gauge" in text
+    assert "aios_tpu_c_total 0" in text  # unlabeled series exists at 0
+
+
+def test_unlabeled_metrics_render_before_any_activity(reg):
+    M.Histogram("aios_tpu_h_seconds", "h", buckets=(1.0,), registry=reg)
+    text = reg.render()
+    assert "aios_tpu_h_seconds_count 0" in text
