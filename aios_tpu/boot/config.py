@@ -60,6 +60,17 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             # dropped for those models since pages cannot split across
             # sp shards).
             "mesh": "",
+            # serving layer (docs/SERVING.md): replicas per managed model
+            # behind the cache-aware router; per-tenant token-bucket
+            # quota (tokens/sec + burst, 0 = off); bounded admission
+            # queue per replica (an EXPLICIT max_queue = 0 means
+            # unbounded, same as the env knob); deadline-feasibility
+            # rate floor. "" = unset (serving defaults apply).
+            "replicas": "",
+            "tenant_tokens_per_sec": "",
+            "tenant_burst_tokens": "",
+            "max_queue": "",
+            "assumed_tps": "",
         },
         "api": {
             "claude_model": "claude-sonnet-4-20250514",
@@ -189,4 +200,25 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         put("AIOS_TPU_JSON_MODE", str(m["json_mode"]))
     if m.get("guided_toolcalls"):
         put("AIOS_TPU_GUIDED_TOOLCALLS", "1")
+    # serving-layer knobs (docs/SERVING.md): numeric; "" = unset (the
+    # serving defaults apply). max_queue forwards an EXPLICIT 0 too —
+    # it means unbounded, not "use the default bound".
+    for cfg_key, env_key, zero_ok in (
+        ("replicas", "AIOS_TPU_REPLICAS", False),
+        ("tenant_tokens_per_sec", "AIOS_TPU_TENANT_TOKENS_PER_SEC", False),
+        ("tenant_burst_tokens", "AIOS_TPU_TENANT_BURST_TOKENS", False),
+        ("max_queue", "AIOS_TPU_MAX_QUEUE", True),
+        ("assumed_tps", "AIOS_TPU_ASSUMED_TPS", False),
+    ):
+        raw = m.get(cfg_key, "")
+        if raw in ("", None):
+            continue
+        try:
+            value = float(raw)
+        except (TypeError, ValueError):
+            log.warning("[models] %s=%r is not a number; ignored",
+                        cfg_key, raw)
+            continue
+        if value > 0 or (value == 0 and zero_ok):
+            put(env_key, str(int(value) if value == int(value) else value))
     return env

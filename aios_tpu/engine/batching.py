@@ -73,6 +73,7 @@ class _Live:
     first_token_at: float = 0.0
     submitted_at: float = 0.0
     done: bool = False
+    admitted_at: float = 0.0  # first slot assignment (queue-wait boundary)
     cancelled: bool = False  # set by RequestHandle.cancel(); reaped by _tick
     # non-empty when the request was ABORTED (scheduler failure, model
     # unload) rather than finished/cancelled — consumers must not present
@@ -249,9 +250,18 @@ class ContinuousBatcher:
                      else 0.0)(_ref())
         )
         # tokens/sec gauge state: emitted tokens over a ~1 s window,
-        # refreshed from the scheduler loop (decays to 0 when idle)
+        # refreshed from the scheduler loop (decays to 0 when idle).
+        # last_tps additionally keeps the most recent NON-ZERO rate so the
+        # serving layer's deadline estimates survive idle gaps (the gauge
+        # honestly decays to 0; feasibility math wants "how fast does this
+        # replica decode when it decodes").
         self._rate_tokens = 0
         self._rate_t0 = time.monotonic()
+        self.last_tps = 0.0
+        # optional serving-layer hook: a Histogram child observed with the
+        # submit->slot-assignment wait of each admitted request
+        # (ReplicaPool sets it; None keeps the engine layer obs-free)
+        self.queue_wait_obs = None
         self._thread = threading.Thread(
             target=self._run, name="continuous-batcher", daemon=True
         )
@@ -333,6 +343,46 @@ class ContinuousBatcher:
             return len(self._waiting) + (
                 1 if self._prefilling is not None else 0
             )
+
+    def outstanding_tokens(self) -> int:
+        """Work queued on this batcher, in tokens: waiting requests count
+        prompt + budget (prefill is still ahead of them), live requests
+        their remaining budget. Budgets are CAPPED at what the cache can
+        actually hold — a max_tokens=50k request on an 8k context retires
+        at the cache end, and counting the phantom 42k would make the
+        serving layer's deadline estimates shed feasible requests. The
+        router's least-loaded score and the admission layer's
+        deadline-feasibility estimate both read this."""
+        cap = self.engine.max_context
+        with self._qlock:
+            waiting = list(self._waiting)
+            if self._prefilling is not None:
+                waiting.append(self._prefilling[0])
+        total = 0
+        for l in waiting:
+            # prompts truncate to the last cap-1 ids at admission — count
+            # what will actually prefill, not the client's raw length —
+            # and decode retires at the cache end, so the budget term is
+            # bounded by the room left AFTER that prompt
+            p = min(len(l.req.prompt_ids), cap - 1)
+            total += p + max(min(l.req.max_tokens, cap - p), 0)
+        with self._lock:
+            total += sum(
+                max(
+                    min(
+                        l.req.max_tokens - l.produced,
+                        cap - self.engine.slot_length(l.slot),
+                    ),
+                    0,
+                )
+                for l in self._live.values()
+            )
+        return total
+
+    def tokens_per_second(self) -> float:
+        """Most recent non-zero observed decode rate (tokens/sec across
+        all slots); 0.0 until the first measured window."""
+        return self.last_tps
 
     def submit(self, req: Request) -> RequestHandle:
         if not req.prompt_ids:
@@ -442,6 +492,7 @@ class ContinuousBatcher:
                     self._prefilling = None
                     self._reserved_slot = -1
                     live.done = True
+                    live.abort_reason = "evicted: KV pool exhausted"
                     self.engine.release(live.slot)
                     live.out_q.put(_END)
                     return
@@ -478,6 +529,15 @@ class ContinuousBatcher:
                     + (now - l.submitted_at) / PRIORITY_AGING_SECS,
                 )
                 self._waiting.remove(live)
+            if not live.admitted_at:
+                # first slot assignment ends the queue wait (requeues —
+                # pool-exhaustion retries, chunked-admission turns — keep
+                # their original boundary)
+                live.admitted_at = time.monotonic()
+                if self.queue_wait_obs is not None:
+                    self.queue_wait_obs.observe(
+                        live.admitted_at - live.submitted_at
+                    )
             alloc = self.engine.allocator
             if alloc is not None and alloc.replicas > 1:
                 # dp-partitioned pool: admit onto the replica with the
@@ -512,6 +572,7 @@ class ContinuousBatcher:
                     "page pool; failing it", live.req.request_id, len(ids),
                 )
                 live.done = True
+                live.abort_reason = "prompt exceeds the KV page pool"
                 live.out_q.put(_END)
                 continue
             chunked = self.prefill_chunk is not None and len(ids) > self.prefill_chunk
@@ -552,6 +613,7 @@ class ContinuousBatcher:
                     with self._qlock:
                         self._waiting.popleft()
                     live.done = True
+                    live.abort_reason = "prompt exceeds the KV page pool"
                     live.out_q.put(_END)
                 # "blocked": the pool is held by strictly higher-priority
                 # streams — the admission stays queued and retries as they
@@ -593,8 +655,14 @@ class ContinuousBatcher:
         if hit_stop or out_of_budget or out_of_cache:
             self._finish(live)
 
-    def _finish(self, live: _Live, *, was_cancelled: bool = False) -> None:
+    def _finish(self, live: _Live, *, was_cancelled: bool = False,
+                abort_reason: str = "") -> None:
         live.done = True
+        if abort_reason:
+            # the stream is a truncation, not a completion: consumers see
+            # handle.aborted and surface an error/resubmit condition
+            # instead of presenting the cut-short text as a normal answer
+            live.abort_reason = abort_reason
         with self._lock:
             self._live.pop(live.slot, None)
         self.engine.release(live.slot)
@@ -678,7 +746,10 @@ class ContinuousBatcher:
         )
         self.pool_evictions += 1
         self._obs_evictions.inc()
-        self._finish(victim)
+        # the victim's stream is a truncation: mark it aborted so the
+        # serving layer returns an error/resubmittable status instead of
+        # a silently short normal completion
+        self._finish(victim, abort_reason="evicted: KV pool exhausted")
         return "evicted"
 
     def _abort_all(self, exc: BaseException) -> None:
@@ -726,7 +797,12 @@ class ContinuousBatcher:
     def _tick(self) -> None:
         now = time.monotonic()
         if now - self._rate_t0 >= 1.0:
-            self._obs_tps.set(self._rate_tokens / (now - self._rate_t0))
+            rate = self._rate_tokens / (now - self._rate_t0)
+            self._obs_tps.set(rate)
+            if rate > 0:
+                # remember the decoding-time rate across idle windows (the
+                # gauge decays to 0; deadline feasibility must not)
+                self.last_tps = rate
             self._rate_tokens = 0
             self._rate_t0 = now
         self._reap_cancelled()

@@ -36,6 +36,10 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: Optional[int] = None
     norm_topk_prob: bool = True
+    # serving replicas per managed model (aios_tpu/serving/): N independent
+    # engine+batcher replicas behind one cache-aware router. 1 = the
+    # single-engine layout; AIOS_TPU_REPLICAS overrides at load time.
+    replicas: int = 1
 
     @property
     def moe(self) -> bool:

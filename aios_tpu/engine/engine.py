@@ -124,8 +124,11 @@ def _validate_prequantized_tp(params, tp: int) -> None:
     """A prepared (unfused) quantized tree must have been quantized for
     THIS tp degree: int4 scale groups are picked from shard-local dims, so
     a mismatched plan would hand the per-device kernel groups it cannot
-    serve. Raise with the re-prepare recipe instead of failing inside
-    shard_map."""
+    serve — and int8 {'q','s'} leaves need their SHARDED dim divisible by
+    tp (N for column-parallel projections, K for the row-parallel
+    _ROW_PARALLEL_KEYS) or the mismatch only surfaces as an opaque GSPMD
+    shape error inside the first dispatch. Raise with the re-prepare
+    recipe instead."""
     if tp <= 1:
         return
     from ..ops.int4_matmul import kernel_supported
@@ -134,24 +137,35 @@ def _validate_prequantized_tp(params, tp: int) -> None:
     if isinstance(params.get("lm_head"), dict):
         leaves["lm_head"] = params["lm_head"]
     bad = []
+    mode = "int8"
     for key, v in leaves.items():
-        if not (isinstance(v, dict) and "q4" in v):
+        if not isinstance(v, dict):
             continue
-        K, N = v["q4"].shape[-2] * 2, v["q4"].shape[-1]
-        groups = v["s4"].shape[-3]
-        group = K // groups
-        if key in _ROW_PARALLEL_KEYS:
-            ok = (K % tp == 0 and groups % tp == 0
-                  and kernel_supported(K // tp, N, group))
+        if "q4" in v:
+            mode = "int4"
+            K, N = v["q4"].shape[-2] * 2, v["q4"].shape[-1]
+            groups = v["s4"].shape[-3]
+            group = K // groups
+            if key in _ROW_PARALLEL_KEYS:
+                ok = (K % tp == 0 and groups % tp == 0
+                      and kernel_supported(K // tp, N, group))
+            else:
+                ok = N % tp == 0 and kernel_supported(K, N // tp, group)
+        elif "q" in v:
+            # int8: the contraction dim K shards for row-parallel
+            # projections, the output dim N (and its per-channel scales)
+            # everywhere else — quantize_params's tp rule
+            K, N = v["q"].shape[-2], v["q"].shape[-1]
+            ok = (K % tp == 0) if key in _ROW_PARALLEL_KEYS else (N % tp == 0)
         else:
-            ok = N % tp == 0 and kernel_supported(K, N // tp, group)
+            continue
         if not ok:
             bad.append(key)
     if bad:
         raise ValueError(
-            f"prepared int4 checkpoint is not servable under tp={tp} "
+            f"prepared {mode} checkpoint is not servable under tp={tp} "
             f"(leaves {', '.join(bad)}): re-run scripts/prepare_model.py "
-            f"--quantize int4 --tp {tp} so shard-local eligibility and "
+            f"--quantize {mode} --tp {tp} so shard-local eligibility and "
             "scale groups are baked for this plan"
         )
 
@@ -1244,6 +1258,37 @@ class TPUEngine:
             return
         pages = [int(self.allocator.tables[slot, b]) for b in range(len(hashes))]
         self.prefix_index.put(hashes, pages)
+
+    def prefix_hashes(self, token_ids: List[int]) -> List[bytes]:
+        """Chain hashes of the prompt's full blocks, truncated exactly as
+        admission truncates — computed ONCE per request by the serving
+        pool and shared across its replicas' overlap probes (replicas of
+        one model share page size and truncation)."""
+        if self.prefix_index is None:
+            return []
+        ids = list(token_ids)[-(self.max_context - 1) :]
+        P = self.allocator.page_size
+        full = (len(ids) - 1) // P
+        if full <= 0:
+            return []
+        return paged.chain_hashes(ids, P, full)
+
+    def prefix_overlap_rows(self, token_ids: List[int],
+                            hashes: Optional[List[bytes]] = None) -> int:
+        """How many leading prompt rows this engine's prefix cache already
+        holds — the serving router's cache-aware score. Read-only: no
+        hit/miss counters move, no LRU refresh, no pages map (scoring N
+        replicas per request must not perturb the index), and it takes
+        only the index's own lock — never the dispatch lock, so a replica
+        mid-dispatch (or mid-compile) cannot stall routing. 0 on
+        non-paged engines or when no full block matches."""
+        if self.prefix_index is None:
+            return 0
+        if hashes is None:
+            hashes = self.prefix_hashes(token_ids)
+        if not hashes:
+            return 0
+        return self.prefix_index.peek(hashes) * self.allocator.page_size
 
     # -- public API ---------------------------------------------------------
 

@@ -250,11 +250,19 @@ class PrefixIndex:
             raise ValueError(
                 "PrefixIndex requires an unreplicated pool (replicas=1)"
             )
+        import threading
+
         self.alloc = allocator
         self.max_pages = max_pages
         self._index: "OrderedDict[int, int]" = OrderedDict()  # hash -> page
         self.hits = 0
         self.misses = 0
+        # the index carries its OWN lock (not the engine dispatch lock):
+        # the serving router peeks it per incoming request, and a probe
+        # that had to wait for an in-flight decode dispatch — or a
+        # multi-second first-call XLA compile — would stall pool-wide
+        # admission behind one replica's graph build
+        self._lock = threading.Lock()
         allocator.reclaimer = self.reclaim
 
     def match(self, hashes: Sequence[int]) -> List[int]:
@@ -262,48 +270,66 @@ class PrefixIndex:
         positions refreshed). No references are taken — the caller maps
         them via ``PageAllocator.map_shared`` under the engine lock."""
         pages: List[int] = []
-        for h in hashes:
-            page = self._index.get(h)
-            if page is None:
-                break
-            self._index.move_to_end(h)
-            pages.append(page)
-        if pages:
-            self.hits += 1
-        else:
-            self.misses += 1
+        with self._lock:
+            for h in hashes:
+                page = self._index.get(h)
+                if page is None:
+                    break
+                self._index.move_to_end(h)
+                pages.append(page)
+            if pages:
+                self.hits += 1
+            else:
+                self.misses += 1
         return pages
+
+    def peek(self, hashes: Sequence[bytes]) -> int:
+        """Length of the longest indexed prefix of ``hashes`` WITHOUT
+        touching hit/miss counters or LRU order — the serving router's
+        read-only overlap probe (scoring N replicas per request must not
+        skew the cache statistics or keep cold entries artificially
+        warm)."""
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._index:
+                    break
+                n += 1
+        return n
 
     def put(self, hashes: Sequence[int], pages: Sequence[int]) -> None:
         """Register freshly computed prefix blocks (one index reference
         each); evicts LRU entries past ``max_pages``."""
-        for h, page in zip(hashes, pages):
-            if h in self._index:
-                self._index.move_to_end(h)
-                continue
-            self.alloc.incref(page)
-            self._index[h] = page
-        while len(self._index) > self.max_pages:
-            _, old = self._index.popitem(last=False)
-            self.alloc.decref(old)
+        with self._lock:
+            for h, page in zip(hashes, pages):
+                if h in self._index:
+                    self._index.move_to_end(h)
+                    continue
+                self.alloc.incref(page)
+                self._index[h] = page
+            while len(self._index) > self.max_pages:
+                _, old = self._index.popitem(last=False)
+                self.alloc.decref(old)
 
     def clear(self) -> None:
         """Drop every entry (and its page reference)."""
-        while self._index:
-            _, page = self._index.popitem(last=False)
-            self.alloc.decref(page)
+        with self._lock:
+            while self._index:
+                _, page = self._index.popitem(last=False)
+                self.alloc.decref(page)
 
     def reclaim(self, n: int) -> int:
         """Drop up to ``n`` cold entries whose pages are held ONLY by the
         index (rc 1) — called by the allocator when the free list runs
         dry. Entries still shared by live slots are left alone."""
         freed = 0
-        for h in list(self._index):
-            if freed >= n:
-                break
-            page = self._index[h]
-            if self.alloc._rc[0, page] == 1:
-                del self._index[h]
-                self.alloc.decref(page)
-                freed += 1
+        with self._lock:
+            for h in list(self._index):
+                if freed >= n:
+                    break
+                page = self._index[h]
+                if self.alloc._rc[0, page] == 1:
+                    del self._index[h]
+                    self.alloc.decref(page)
+                    freed += 1
         return freed

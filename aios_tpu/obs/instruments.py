@@ -139,6 +139,49 @@ RUNTIME_MODELS_READY = Gauge(
     "Models in the ready state (scrape-time)",
 )
 
+# -- serving layer (replica pool + router + admission, aios_tpu/serving/) --
+# Labeled by the MANAGED model name (pool name), not the config name —
+# two managed models of the same architecture must not collapse into one
+# series. ``replica`` is the replica index (bounded by the replica count).
+
+SERVING_REPLICAS = Gauge(
+    "aios_tpu_serving_replicas_total",
+    "Live replicas in the pool (scrape-time)",
+    ("model",),
+)
+SERVING_REPLICA_OCCUPANCY = Gauge(
+    "aios_tpu_serving_replica_occupancy_ratio",
+    "Per-replica active decode slots / total slots (scrape-time)",
+    ("model", "replica"),
+)
+SERVING_ROUTING_DECISIONS = Counter(
+    "aios_tpu_serving_routing_decisions_total",
+    "Replica selections by reason (prefix|sticky|least_loaded|spill|single)",
+    ("model", "reason"),
+)
+SERVING_SHED = Counter(
+    "aios_tpu_serving_shed_total",
+    "Requests shed at the front door, by cause "
+    "(quota|deadline|queue_full|draining)",
+    ("model", "cause"),
+)
+SERVING_QUOTA_REJECTIONS = Counter(
+    "aios_tpu_serving_quota_rejections_total",
+    "Token-bucket quota rejections per tenant",
+    ("tenant",),
+)
+SERVING_QUEUE_WAIT = Histogram(
+    "aios_tpu_serving_queue_wait_seconds",
+    "Submission -> batcher admission (slot assignment) wall time",
+    ("model",),
+)
+SERVING_REPLICA_RESTARTS = Counter(
+    "aios_tpu_serving_replica_restarts_total",
+    "Replica batchers respawned after a scheduler crash "
+    "(the spawner-style restart counter, serving-side)",
+    ("model",),
+)
+
 # -- orchestrator ----------------------------------------------------------
 
 GOAL_TASKS = Counter(

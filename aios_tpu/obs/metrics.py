@@ -207,6 +207,20 @@ class Metric:
                 self._children[key] = child
             return child
 
+    def remove(self, **labelvalues: str) -> None:
+        """Drop one child series. For pull-gauges whose owner is going
+        away for good (e.g. a replica pool shrinking on hot-swap) —
+        without this the dead series scrapes as a misleading constant
+        forever. No-op when the series does not exist."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(labelvalues))}"
+            )
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
     def _iter_children(self):
         with self._lock:
             return list(self._children.items())

@@ -49,6 +49,7 @@ from ..engine.tokenizer import (
     HFTokenizer,
     gguf_tokenizer,
 )
+from ..serving import ReplicaPool, ServingConfig
 
 log = logging.getLogger("aios.runtime.models")
 
@@ -70,6 +71,8 @@ LEVEL_LADDERS: Dict[str, List[str]] = {
 class ManagedModel:
     name: str
     config: ModelConfig
+    # replica 0's engine/batcher, kept for single-replica callers and
+    # HealthCheck snapshots; the POOL is the serving entry point
     engine: TPUEngine
     batcher: ContinuousBatcher
     tokenizer: BaseTokenizer
@@ -81,11 +84,28 @@ class ManagedModel:
     # estimated per-chip HBM this model pins (weights + KV); co-resident
     # loads subtract it from the auto-degradation budget
     hbm_chip_bytes: float = 0.0
+    # the replica pool fronting this model (aios_tpu/serving/): admission
+    # -> cache-aware routing -> one replica's batcher. None only for
+    # error-state placeholders.
+    pool: Optional[ReplicaPool] = None
+    # load identity, so a LoadModel for the same name with a different
+    # source/geometry hot-swaps instead of returning the stale pool
+    model_path: str = ""
+    context_length: int = 0
     lock: threading.Lock = field(default_factory=threading.Lock)
 
     def touch(self) -> None:
         self.last_used = int(time.time())
         self.request_count += 1
+
+    def submit(self, req, tenant: str = "anonymous", deadline_s=None):
+        """Serving entry point: through the pool (admission + routing)
+        when present, straight to the batcher otherwise. Raises
+        serving.AdmissionError on shed."""
+        pool = self.pool
+        if pool is not None:
+            return pool.submit(req, tenant=tenant, deadline_s=deadline_s)
+        return self.batcher.submit(req)
 
 
 def _context_for_file_size(n_bytes: int) -> int:
@@ -280,14 +300,19 @@ class ModelManager:
         ).lower() in ("1", "true", "on")
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _kv_row_bytes(cfg, cache_dtype) -> float:
+        """Bytes one KV row (both k and v, all layers) occupies."""
+        item = 1 if cache_dtype == jnp.int8 else 2
+        return 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * item
+
     def _kv_bytes_per_chip(self, cfg, ctx, cache_dtype, kw) -> float:
         """Estimated per-chip HBM the KV cache will pin under the current
         plan: slots shard over dp and kv heads over tp; the paged pool's
         rows split across dp replicas. sp does NOT divide the estimate
         unless the cache is seq-sharded — which is exactly what the
         auto-degrade check decides."""
-        item = 1 if cache_dtype == jnp.int8 else 2
-        row = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * item
+        row = self._kv_row_bytes(cfg, cache_dtype)
         dp = tp = 1
         if self.plan is not None:
             dp, tp = self.plan.dp, self.plan.tp
@@ -295,6 +320,32 @@ class ModelManager:
         return row * rows / (dp * tp)
 
     # -- loading ------------------------------------------------------------
+
+    def _replica_plans(self, n: int) -> List:
+        """One sharding plan per replica. With enough devices each replica
+        gets its OWN submesh slice (n disjoint dp x sp x ep x tp meshes);
+        otherwise every replica shares the manager's plan/devices — the
+        CPU-test and oversubscribed layout."""
+        if n <= 1 or self.plan is None:
+            return [self.plan] * n
+        plan = self.plan
+        size = plan.dp * plan.sp * plan.ep * plan.tp
+        devs = jax.devices()
+        if len(devs) < n * size:
+            log.info(
+                "%d replicas share one %d-device mesh (%d devices visible)",
+                n, size, len(devs),
+            )
+            return [plan] * n
+        from ..parallel.sharding import ShardingPlan, build_mesh
+
+        return [
+            ShardingPlan(build_mesh(
+                devices=devs[i * size:(i + 1) * size],
+                dp=plan.dp, sp=plan.sp, ep=plan.ep, tp=plan.tp,
+            ))
+            for i in range(n)
+        ]
 
     def load_model(
         self,
@@ -304,12 +355,42 @@ class ModelManager:
     ) -> ManagedModel:
         with self._lock:
             existing = self.models.get(name)
-            if existing is not None and existing.state == STATE_READY:
+        if existing is not None and existing.state == STATE_READY:
+            want_replicas = ServingConfig.from_env(
+                existing.config.replicas
+            ).replicas
+            have_replicas = (
+                len(existing.pool.replicas) if existing.pool is not None else 1
+            )
+            if (
+                existing.model_path == path
+                and existing.context_length == (context_length or 0)
+                and have_replicas == want_replicas
+            ):
                 return existing
+            # different source/geometry/replica count: fall through and
+            # HOT-SWAP — the new pool is built first, swapped into the
+            # registry, and the old one drains in the background so
+            # in-flight streams finish on the engines they started on
+            log.info(
+                "%s: reload with changed config; hot-swapping the pool",
+                name,
+            )
 
         t0 = time.time()
         try:
             cfg, params, tokenizer = self._load_weights(name, path, context_length)
+            serving_cfg = ServingConfig.from_env(cfg.replicas)
+            n_replicas = max(1, serving_cfg.replicas)
+            plans = self._replica_plans(n_replicas)
+            # replicas on DISJOINT submeshes cost 1x per chip (each chip
+            # hosts one replica); replicas sharing a device set multiply
+            # the per-chip footprint — both the budget check below and the
+            # recorded hbm_chip_bytes must use the same factor
+            repl_factor = n_replicas
+            if n_replicas > 1 and self.plan is not None \
+                    and plans[0] is not self.plan:
+                repl_factor = 1
             cache_dtype = self.cache_dtype
             ctx = context_length or cfg.max_context
             kw = {}
@@ -389,33 +470,64 @@ class ModelManager:
                 # co-resident models' footprints count against the budget.
                 # Without a usable sp axis the shortfall is still WARNED so
                 # the first symptom isn't a serve-time OOM.
+                # co-resident models count against the budget — INCLUDING
+                # a still-READY same-name entry: during a hot-swap the old
+                # pool keeps serving (and pinning HBM) while the new one
+                # builds, so the transient is 2x, not a replacement
                 resident = sum(
                     mm.hbm_chip_bytes for mm in self.models.values()
-                    if mm.name != name
+                    if mm.name != name or mm.state == STATE_READY
                 )
-                budget = _chip_hbm_bytes() * 0.85 - weight_chip - resident
+                budget = (
+                    _chip_hbm_bytes() * 0.85
+                    - weight_chip * repl_factor - resident
+                )
                 sp = self.plan.sp if self.plan is not None else 1
-                if kv_chip > max(budget, 0.0):
-                    if sp > 1 and ctx % sp == 0:
+                if kv_chip * repl_factor > max(budget, 0.0):
+                    # the seq-sharded config is a DENSE num_slots x ctx
+                    # cache sharded over dp x tp x sp — recompute its
+                    # estimate rather than dividing the PAGED estimate by
+                    # sp (the paged pool may hold more rows than the dense
+                    # cache, which overstated the degraded footprint and
+                    # could degrade onto a layout that saves nothing)
+                    dp = self.plan.dp if self.plan is not None else 1
+                    tp = self.plan.tp if self.plan is not None else 1
+                    seq_kv = (
+                        self._kv_row_bytes(cfg, cache_dtype)
+                        * self.num_slots * ctx / (dp * tp * sp)
+                    )
+                    if sp > 1 and ctx % sp == 0 and seq_kv < kv_chip:
                         log.warning(
                             "%s: KV cache needs ~%.1f GB/chip (budget "
                             "~%.1f GB after weights + co-resident "
                             "models); sharding the context axis over "
-                            "sp=%d and dropping the paged pool",
-                            name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
-                            sp,
+                            "sp=%d (~%.1f GB/chip%s) and dropping the "
+                            "paged pool",
+                            name, kv_chip * repl_factor / 1e9,
+                            max(budget, 0.0) / 1e9,
+                            sp, seq_kv * repl_factor / 1e9,
+                            "" if seq_kv * repl_factor <= max(budget, 0.0)
+                            else ", STILL over budget — HBM may overflow",
                         )
                         kw = dict(seq_sharded_cache=True)
-                        hbm_estimate = weight_chip + kv_chip / sp
+                        hbm_estimate = weight_chip + seq_kv
                     else:
+                        if sp <= 1:
+                            why = "no sp axis in the mesh"
+                        elif ctx % sp:
+                            why = f"context {ctx} does not divide by sp={sp}"
+                        else:
+                            why = (
+                                f"the seq-sharded cache (~{seq_kv / 1e9:.1f}"
+                                " GB/chip) would not shrink the footprint"
+                            )
                         log.warning(
                             "%s: KV cache needs ~%.1f GB/chip (budget "
                             "~%.1f GB) and the seq-sharded degradation "
                             "is unavailable (%s) — loading anyway and "
                             "HBM may overflow",
-                            name, kv_chip / 1e9, max(budget, 0.0) / 1e9,
-                            f"context {ctx} does not divide by sp={sp}"
-                            if sp > 1 else "no sp axis in the mesh",
+                            name, kv_chip * repl_factor / 1e9,
+                            max(budget, 0.0) / 1e9, why,
                         )
             quantize = self.quantize
             if not self.quantize_explicit:
@@ -437,63 +549,126 @@ class ModelManager:
                         "prepare_model without --quantize for bf16 "
                         "serving)", name, _prequantized_mode(params),
                     )
-            engine = TPUEngine(
-                cfg,
-                params,
-                num_slots=self.num_slots,
-                max_context=ctx,
-                shardings=self.plan,
-                quantize=quantize,
-                cache_dtype=cache_dtype,
-                # the per-step history scatter serves only the n-gram
-                # speculative proposer — skip it (and its serial scan
-                # dependency) when speculative serving is off
-                track_history=self.speculative,
-                **kw,
-            )
-            del params
-            if self.warm_compile:
-                # json-mode deployments dispatch the grammar-masked step;
-                # compile it behind the readiness gate too
-                from .service import json_mode_forced
+            engines = []
+            try:
+                for i in range(n_replicas):
+                    engine = TPUEngine(
+                        cfg,
+                        params,
+                        num_slots=self.num_slots,
+                        max_context=ctx,
+                        shardings=plans[i],
+                        quantize=quantize,
+                        cache_dtype=cache_dtype,
+                        # the per-step history scatter serves only the
+                        # n-gram speculative proposer — skip it (and its
+                        # serial scan dependency) when speculative
+                        # serving is off
+                        track_history=self.speculative,
+                        **kw,
+                    )
+                    if self.warm_compile:
+                        # json-mode deployments dispatch the grammar-masked
+                        # step; compile it behind the readiness gate too
+                        from .service import json_mode_forced
 
-                engine.warmup(masked_step=json_mode_forced())
-            batcher = ContinuousBatcher(
-                engine, speculative=self.speculative, tokenizer=tokenizer
-            )
+                        engine.warmup(masked_step=json_mode_forced())
+                    engines.append(engine)
+            except BaseException:
+                # a failed replica build must not strand its siblings'
+                # HBM until a gc pass
+                for e in engines:
+                    try:
+                        e.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
+            del params
+
+            def batcher_factory(eng, _tok=tokenizer):
+                # the pool's spawn AND crash-respawn path — a replica
+                # whose scheduler died gets an identical fresh batcher
+                return ContinuousBatcher(
+                    eng, speculative=self.speculative, tokenizer=_tok
+                )
+
+            try:
+                pool = ReplicaPool(
+                    name, engines, batcher_factory, serving_cfg
+                )
+            except BaseException:
+                # the pool shuts its partial batchers down itself; the
+                # engines are still ours to free
+                for e in engines:
+                    try:
+                        e.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
             managed = ManagedModel(
                 name=name,
                 config=cfg,
-                engine=engine,
-                batcher=batcher,
+                engine=engines[0],
+                batcher=pool.replicas[0].batcher,
                 tokenizer=tokenizer,
                 state=STATE_READY,
                 loaded_at=int(time.time()),
-                hbm_chip_bytes=hbm_estimate,
+                # every replica pins its own weights + KV; co-resident
+                # replicas (shared device set) multiply the per-chip
+                # footprint, disjoint submeshes pay 1x per chip
+                hbm_chip_bytes=hbm_estimate * repl_factor,
+                pool=pool,
+                model_path=path,
+                context_length=context_length or 0,
             )
+            # keep the replica-0 snapshot fresh across crash-respawns
+            # (the pool swaps Replica.batcher; the ManagedModel field
+            # would otherwise point at the dead scheduler)
+            def _sync_batcher(idx, b, _m=managed):
+                if idx == 0:
+                    _m.batcher = b
+
+            pool.on_respawn = _sync_batcher
             with self._lock:
+                old = self.models.get(name)
                 self.models[name] = managed
+            if old is not None and old is not managed \
+                    and old.state == STATE_READY:
+                self._retire_async(old)
             log.info(
-                "model %s ready in %.1fs (ctx=%d, %d slots)",
+                "model %s ready in %.1fs (ctx=%d, %d slots, %d replica%s)",
                 name,
                 time.time() - t0,
-                engine.max_context,
-                engine.num_slots,
+                engines[0].max_context,
+                engines[0].num_slots,
+                n_replicas,
+                "" if n_replicas == 1 else "s",
             )
             return managed
         except Exception as exc:
-            managed = ManagedModel(
-                name=name,
-                config=TINY_TEST,
-                engine=None,  # type: ignore[arg-type]
-                batcher=None,  # type: ignore[arg-type]
-                tokenizer=ByteTokenizer(),
-                state=STATE_ERROR,
-                error=str(exc),
-            )
+            # a FAILED hot-swap must not clobber the still-serving model:
+            # keep the READY entry (its pool keeps serving; the caller
+            # still sees the load error) and only register the error
+            # placeholder when there was nothing working to preserve
             with self._lock:
-                self.models[name] = managed
-            log.error("model %s failed to load: %s", name, exc)
+                cur = self.models.get(name)
+                if cur is None or cur.state != STATE_READY:
+                    self.models[name] = ManagedModel(
+                        name=name,
+                        config=TINY_TEST,
+                        engine=None,  # type: ignore[arg-type]
+                        batcher=None,  # type: ignore[arg-type]
+                        tokenizer=ByteTokenizer(),
+                        state=STATE_ERROR,
+                        error=str(exc),
+                    )
+            if cur is not None and cur.state == STATE_READY:
+                log.error(
+                    "model %s reload failed (%s); the previous pool keeps "
+                    "serving", name, exc,
+                )
+            else:
+                log.error("model %s failed to load: %s", name, exc)
             raise
 
     def _load_weights(self, name: str, path: str, context_length: int):
@@ -596,15 +771,45 @@ class ModelManager:
         if managed is None:
             return False
         managed.state = STATE_UNLOADING
-        if managed.batcher is not None:
-            managed.batcher.shutdown()
-        # engine.close() frees HBM deterministically — the jitted-step
-        # closures form a ref cycle with the engine, so plain deref would
-        # leave the weights resident until a gc pass
-        if managed.engine is not None:
-            managed.engine.close()
+        # the pool shuts every replica down (batcher + engine.close() —
+        # close frees HBM deterministically; the jitted-step closures form
+        # a ref cycle with the engine, so plain deref would leave the
+        # weights resident until a gc pass)
+        if managed.pool is not None:
+            managed.pool.shutdown()
+        else:
+            if managed.batcher is not None:
+                managed.batcher.shutdown()
+            if managed.engine is not None:
+                managed.engine.close()
         managed.engine = None  # type: ignore[assignment]
+        managed.batcher = None  # type: ignore[assignment]
         return True
+
+    def _retire_async(self, old: ManagedModel) -> None:
+        """Hot-swap retirement: the replacement pool is already in the
+        registry serving new requests; the OLD pool drains its in-flight
+        streams in the background, then frees its HBM. The swapped-out
+        ManagedModel keeps its pool reference until the drain thread is
+        done with it, but its engine/batcher snapshots null immediately
+        (HealthCheck must not read a closing engine)."""
+        old.state = STATE_UNLOADING
+        pool, batcher, engine = old.pool, old.batcher, old.engine
+        old.engine = None  # type: ignore[assignment]
+        old.batcher = None  # type: ignore[assignment]
+
+        def _drain():
+            if pool is not None:
+                pool.shutdown(drain_timeout=30.0)
+            else:
+                if batcher is not None:
+                    batcher.shutdown()
+                if engine is not None:
+                    engine.close()
+
+        threading.Thread(
+            target=_drain, name=f"retire-{old.name}", daemon=True
+        ).start()
 
     # -- resolution ---------------------------------------------------------
 

@@ -30,6 +30,7 @@ from ..proto_gen import common_pb2, runtime_pb2
 from ..services import RUNTIME, AIRuntimeServicer, service_address
 from ..engine.batching import Request
 from ..engine.tokenizer import render_chat
+from ..serving import AdmissionError, tenant_of
 from .model_manager import (
     STATE_READY,
     ManagedModel,
@@ -107,17 +108,23 @@ class RuntimeService(AIRuntimeServicer):
         for m in models:
             # snapshot: a concurrent UnloadModel nulls these fields on the
             # same ManagedModel object mid-iteration
-            engine, batcher = m.engine, m.batcher
-            if engine is not None and batcher is not None:
+            pool, engine, batcher = m.pool, m.engine, m.batcher
+            if pool is not None and engine is not None:
+                # pool.stats() is the pool-level engine.stats(): counters
+                # summed across replicas + routing/shed/occupancy detail
+                stats = pool.stats()
+            elif engine is not None and batcher is not None:
                 stats = engine.stats()
                 stats["pool_evictions"] = batcher.pool_evictions
                 stats["completed"] = batcher.completed
                 stats["cancelled"] = batcher.cancellations
                 stats["waiting"] = batcher.queue_depth()
                 stats["num_slots"] = engine.num_slots
-                details[f"{m.name}.serving"] = ",".join(
-                    f"{k}={v}" for k, v in sorted(stats.items())
-                )
+            else:
+                continue
+            details[f"{m.name}.serving"] = ",".join(
+                f"{k}={v}" for k, v in sorted(stats.items())
+            )
         ready = len(self.manager.ready_models())
         return common_pb2.HealthStatus(
             healthy=True,
@@ -267,16 +274,53 @@ class RuntimeService(AIRuntimeServicer):
             json_mode=json_mode,
             json_schema=schema,
             # admission priority from the request's intelligence level:
+            # priority ranks LATENCY SENSITIVITY as much as intelligence —
             # under slot contention, strategic reasoning admits ahead of
-            # bulk operational traffic (FIFO within a level; no wire
-            # change — the level field already rides InferRequest)
-            priority={"strategic": 3, "tactical": 2, "operational": 1}.get(
-                request.intelligence_level.lower(), 0
-            ),
+            # bulk operational traffic, and a reactive request (a quick
+            # latency-sensitive probe that explicitly named a model — the
+            # ladder rejects model-less reactive calls) ranks with
+            # operational rather than at the bottom with unclassified
+            # traffic (FIFO within a level; no wire change — the level
+            # field already rides InferRequest)
+            priority={
+                "strategic": 3, "tactical": 2, "operational": 1,
+                "reactive": 1,
+            }.get(request.intelligence_level.lower(), 0),
         )
+        # serving front door: per-tenant quota (tenant = agent id / task
+        # prefix, per the pool's AIOS_TPU_TENANT_BY policy), bounded
+        # queues, deadline feasibility — the propagated gRPC deadline is
+        # the request's budget
+        tenant = tenant_of(
+            request, m.pool.cfg.tenant_by if m.pool is not None else "agent"
+        )
+        deadline_s = None
+        if context is not None:
+            tr = context.time_remaining()
+            if tr is not None and tr < 3600 * 24 * 365:
+                deadline_s = tr
         try:
             try:
-                handle = m.batcher.submit(req)
+                handle = m.submit(req, tenant=tenant, deadline_s=deadline_s)
+            except AdmissionError as e:
+                # load shed: RESOURCE_EXHAUSTED + a retry-after-ms
+                # trailing-metadata hint instead of an unbounded queue;
+                # PERMANENT conditions (cost can never fit the bucket)
+                # are INVALID_ARGUMENT so clients don't retry forever
+                if context is not None:
+                    if not e.retriable:
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            f"request not admittable ({e.cause}): {e}",
+                        )
+                    context.set_trailing_metadata(
+                        (("retry-after-ms", str(e.retry_after_ms)),)
+                    )
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"request shed ({e.cause}): {e}",
+                    )
+                raise
             except RuntimeError as e:
                 # submit raced UnloadModel's shutdown: the batcher refuses
                 # (rather than stranding the consumer forever)

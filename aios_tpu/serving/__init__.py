@@ -1,0 +1,25 @@
+"""Serving layer: replica pools, cache-aware routing, admission control.
+
+Sits between the runtime gRPC service and the decode engines —
+``RuntimeService`` talks to a :class:`ReplicaPool` per managed model;
+the pool routes each request to the replica most likely to hold its
+prompt prefix (SGLang-style cache-aware routing, arXiv:2312.07104) and
+sheds work a saturated pool cannot serve inside its deadline
+(RTP-LLM-style admission, arXiv:2605.29639). See docs/SERVING.md.
+"""
+
+from .admission import AdmissionController, AdmissionError, TokenBucket, tenant_of
+from .config import ServingConfig
+from .pool import Replica, ReplicaPool
+from .router import Router
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionError",
+    "Replica",
+    "ReplicaPool",
+    "Router",
+    "ServingConfig",
+    "TokenBucket",
+    "tenant_of",
+]

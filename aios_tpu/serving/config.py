@@ -1,0 +1,90 @@
+"""Serving-layer configuration: replica count, quotas, queues, deadlines.
+
+One dataclass read once at pool construction (ModelManager.load_model),
+so a running pool's policy is immutable — the same lenient-env pattern
+as the sibling AIOS_TPU_* parsers in runtime/model_manager.py: a
+malformed knob logs and falls back instead of taking down a model load.
+Every knob here is documented in docs/SERVING.md and docs/CONFIG.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+log = logging.getLogger("aios.serving")
+
+
+def _env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+        if v < minimum:
+            raise ValueError(f"must be >= {minimum}")
+        return v
+    except ValueError as exc:
+        log.warning("%s=%r ignored (%s); using %s", name, raw, exc, default)
+        return default
+
+
+def _env_int(name: str, default: int, minimum: int = 0) -> int:
+    return int(_env_float(name, float(default), float(minimum)))
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    # replicas per managed model (AIOS_TPU_REPLICAS overrides
+    # ModelConfig.replicas; each replica is its own engine + batcher)
+    replicas: int = 1
+    # per-tenant token-bucket quota: sustained tokens/sec refill and burst
+    # capacity (tokens). 0 tokens/sec = quotas off. A request costs
+    # prompt_tokens + max_tokens up front (the reservation is the bound —
+    # admission cannot know the true decode length).
+    tenant_tokens_per_sec: float = 0.0
+    tenant_burst_tokens: float = 0.0  # 0 -> 4 s of refill
+    # tenant identity: "agent" = requesting_agent, falling back to the
+    # task_id prefix; "task_prefix" = always the task_id prefix
+    tenant_by: str = "agent"
+    # bounded queues: shed (RESOURCE_EXHAUSTED + retry-after-ms) instead
+    # of queueing more than this many waiting requests per replica;
+    # 0 = unbounded (the pre-serving behavior)
+    max_queue: int = 64
+    # cache-aware routing: route to the best prefix-overlapping replica
+    # only when the overlap covers at least this fraction of the prompt;
+    # below it, least-outstanding-tokens wins
+    overlap_min_ratio: float = 0.25
+    # deadline admission: a request is shed when
+    # (replica outstanding tokens + request max_tokens) / observed
+    # tokens-per-sec exceeds the propagated gRPC deadline. When the
+    # observed rate is 0 (cold pool), assumed_tokens_per_sec substitutes;
+    # 0 disables the feasibility check until a rate is observed.
+    assumed_tokens_per_sec: float = 0.0
+
+    @classmethod
+    def from_env(cls, replicas_default: int = 1) -> "ServingConfig":
+        replicas = _env_int("AIOS_TPU_REPLICAS", replicas_default, minimum=1)
+        tps = _env_float("AIOS_TPU_TENANT_TOKENS_PER_SEC", 0.0)
+        burst = _env_float("AIOS_TPU_TENANT_BURST_TOKENS", 0.0)
+        if tps > 0 and burst <= 0:
+            burst = 4.0 * tps
+        tenant_by = os.environ.get("AIOS_TPU_TENANT_BY", "agent").lower()
+        if tenant_by not in ("agent", "task_prefix"):
+            log.warning(
+                "AIOS_TPU_TENANT_BY=%r ignored (expected agent|task_prefix)",
+                tenant_by,
+            )
+            tenant_by = "agent"
+        return cls(
+            replicas=replicas,
+            tenant_tokens_per_sec=tps,
+            tenant_burst_tokens=burst,
+            tenant_by=tenant_by,
+            max_queue=_env_int("AIOS_TPU_MAX_QUEUE", 64),
+            overlap_min_ratio=_env_float(
+                "AIOS_TPU_ROUTE_OVERLAP_MIN", 0.25
+            ),
+            assumed_tokens_per_sec=_env_float("AIOS_TPU_ASSUMED_TPS", 0.0),
+        )

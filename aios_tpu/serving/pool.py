@@ -1,0 +1,350 @@
+"""ReplicaPool: N engine+batcher replicas behind one managed model.
+
+Sits between ``RuntimeService`` and the engines with no wire-format
+change: ``LoadModel``/``UnloadModel`` operate on the pool, every
+``Infer``/``StreamInfer`` goes admission -> routing -> one replica's
+continuous batcher. Lifecycle is coordinated here:
+
+  * **spawn** — the pool builds one batcher per engine through a factory
+    (the same factory respawns crashed ones);
+  * **drain** — stop admitting, let in-flight streams finish;
+  * **hot-swap** — ModelManager builds the NEW pool first, swaps it into
+    the registry, then drains and shuts this one down in the background;
+  * **crash-restart** — a replica whose scheduler thread died (or
+    recorded a fatal error) gets a fresh batcher over the same engine,
+    counted by the spawner-style restart counter
+    (``aios_tpu_serving_replica_restarts_total``).
+
+Everything reports through the PR-1 obs layer (``aios_tpu_serving_*``)
+and ``pool.stats()`` — the pool-level twin of ``engine.stats()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..obs import instruments as obs
+from .admission import AdmissionController, AdmissionError
+from .config import ServingConfig
+from .router import Router
+
+log = logging.getLogger("aios.serving")
+
+ROUTE_REASONS = ("prefix", "sticky", "least_loaded", "spill", "single")
+SHED_CAUSES = ("quota", "deadline", "queue_full", "draining")
+
+
+class Replica:
+    """One engine + its continuous batcher, with the live numbers the
+    router and admission gates read."""
+
+    def __init__(self, idx: int, engine, batcher) -> None:
+        self.idx = idx
+        self.engine = engine
+        self.batcher = batcher
+
+    def overlap_rows(self, prompt_ids: List[int], hashes=None) -> int:
+        fn = getattr(self.engine, "prefix_overlap_rows", None)
+        return fn(prompt_ids, hashes=hashes) if fn is not None else 0
+
+    def prefix_hashes(self, prompt_ids: List[int]):
+        fn = getattr(self.engine, "prefix_hashes", None)
+        return fn(prompt_ids) if fn is not None else []
+
+    def outstanding_tokens(self) -> int:
+        return self.batcher.outstanding_tokens()
+
+    def queue_depth(self) -> int:
+        return self.batcher.queue_depth()
+
+    def tokens_per_second(self) -> float:
+        return self.batcher.tokens_per_second()
+
+    def occupancy(self) -> float:
+        n = self.engine.num_slots
+        return float(self.engine.active.sum()) / n if n else 0.0
+
+    def idle(self) -> bool:
+        return self.queue_depth() == 0 and self.batcher.active_count == 0
+
+    def dead(self) -> bool:
+        """A replica needing a respawn: its scheduler thread exited
+        outside shutdown, or recorded a fatal scheduler error (which
+        aborted every outstanding request — a fresh batcher gives the
+        next request a clean slate)."""
+        b = self.batcher
+        if b._closed:
+            return False  # shutting down, not crashed
+        return b.last_error is not None or not b._thread.is_alive()
+
+
+class ReplicaPool:
+    def __init__(
+        self,
+        name: str,
+        engines: Sequence,
+        batcher_factory: Callable,
+        config: Optional[ServingConfig] = None,
+    ) -> None:
+        if not engines:
+            raise ValueError("a pool needs at least one engine")
+        self.name = name
+        self.cfg = config or ServingConfig()
+        self._factory = batcher_factory
+        self.router = Router(overlap_min_ratio=self.cfg.overlap_min_ratio)
+        self.admission = AdmissionController(self.cfg, name)
+        self.replicas: List[Replica] = []
+        try:
+            for i, e in enumerate(engines):
+                self.replicas.append(Replica(i, e, self._spawn_batcher(e)))
+        except BaseException:
+            # a failed spawn must not leave earlier replicas' scheduler
+            # threads running (the caller will close the engines)
+            for r in self.replicas:
+                try:
+                    r.batcher.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        self.restarts = 0  # spawner-style: batchers respawned after crash
+        # optional hook fired as on_respawn(replica_idx, new_batcher) —
+        # ModelManager uses it to keep ManagedModel's replica-0 batcher
+        # snapshot from going stale after a crash-respawn
+        self.on_respawn: Optional[Callable] = None
+        self._draining = False
+        self._closed = False
+        self._lock = threading.Lock()
+        self._routed: Dict[str, int] = {r: 0 for r in ROUTE_REASONS}
+        self._shed: Dict[str, int] = {c: 0 for c in SHED_CAUSES}
+        self._obs_routed = {
+            r: obs.SERVING_ROUTING_DECISIONS.labels(model=name, reason=r)
+            for r in ROUTE_REASONS
+        }
+        self._obs_restarts = obs.SERVING_REPLICA_RESTARTS.labels(model=name)
+        self._register_gauges()
+
+    def _spawn_batcher(self, engine):
+        b = self._factory(engine)
+        # serving-side queue-wait histogram: observed by the batcher at
+        # slot assignment (see ContinuousBatcher.queue_wait_obs)
+        b.queue_wait_obs = obs.SERVING_QUEUE_WAIT.labels(model=self.name)
+        return b
+
+    def _register_gauges(self) -> None:
+        ref = weakref.ref(self)
+        # (child, bound fn, removal) triples: shutdown drops any series
+        # STILL bound to this pool — a replacement pool of fewer replicas
+        # must not leave the old higher-index series scraping 0.0 forever,
+        # while series a replacement already rebound are left alone
+        self._gauge_bindings = []
+
+        def nrep():
+            p = ref()
+            return float(len(p.replicas)) \
+                if p is not None and not p._closed else 0.0
+
+        child = obs.SERVING_REPLICAS.labels(model=self.name)
+        child.set_function(nrep)
+        self._gauge_bindings.append((
+            child, nrep,
+            lambda: obs.SERVING_REPLICAS.remove(model=self.name),
+        ))
+        for i in range(len(self.replicas)):
+            def occ(i=i):
+                p = ref()
+                if p is None or p._closed or i >= len(p.replicas):
+                    return 0.0
+                return p.replicas[i].occupancy()
+
+            child = obs.SERVING_REPLICA_OCCUPANCY.labels(
+                model=self.name, replica=str(i)
+            )
+            child.set_function(occ)
+            self._gauge_bindings.append((
+                child, occ,
+                lambda i=i: obs.SERVING_REPLICA_OCCUPANCY.remove(
+                    model=self.name, replica=str(i)
+                ),
+            ))
+
+    # -- serving ------------------------------------------------------------
+
+    def submit(self, req, tenant: str = "anonymous",
+               deadline_s: Optional[float] = None):
+        """Admission -> routing -> replica submit. Raises
+        :class:`AdmissionError` when the request is shed (the service
+        maps it to RESOURCE_EXHAUSTED + retry-after-ms metadata)."""
+        try:
+            return self._submit(req, tenant, deadline_s)
+        except AdmissionError as e:
+            with self._lock:
+                self._shed[e.cause] = self._shed.get(e.cause, 0) + 1
+            raise
+
+    def _submit(self, req, tenant: str, deadline_s: Optional[float]):
+        if self._draining or self._closed:
+            raise self.admission.shed(
+                "draining", f"model {self.name} is draining", 2000
+            )
+        self._respawn_dead()
+        # route on the ADMISSION-TRUNCATED prompt (engines keep only the
+        # last max_context-1 ids): the router's overlap threshold is a
+        # fraction of the prompt it compares against cacheable rows, so
+        # an over-length raw prompt would make the prefix route
+        # unreachable. Hash the blocks ONCE; every replica's probe reuses
+        # the digests (replicas share page size and truncation).
+        cap = getattr(self.replicas[0].engine, "max_context", None)
+        route_ids = req.prompt_ids
+        if cap is not None and len(route_ids) > cap - 1:
+            route_ids = route_ids[-(cap - 1):]
+        hashes = self.replicas[0].prefix_hashes(route_ids)
+        idx, reason = self.router.select(
+            self.replicas, route_ids, req.request_id, hashes=hashes
+        )
+        if (
+            self.cfg.max_queue > 0
+            and len(self.replicas) > 1
+            and self.replicas[idx].queue_depth() >= self.cfg.max_queue
+        ):
+            # spill: a full cache-preferred replica must not shed while a
+            # sibling has queue room (losing the prefix hit beats a shed)
+            # — least-loaded AMONG the replicas with room, not overall
+            # (the global minimum can itself be full of small budgets)
+            with_room = [
+                i for i, rep in enumerate(self.replicas)
+                if rep.queue_depth() < self.cfg.max_queue
+            ]
+            if with_room:
+                alt = min(
+                    with_room,
+                    key=lambda i: self.replicas[i].outstanding_tokens(),
+                )
+                idx, reason = alt, "spill"
+        r = self.replicas[idx]
+        self.admission.check_queue(
+            r.queue_depth(), r.outstanding_tokens(), r.tokens_per_second()
+        )
+        # the cache caps what this request can actually decode — a giant
+        # max_tokens on a small context (or after a long prompt) is not a
+        # giant deadline requirement; the truncated prompt length is what
+        # actually occupies cache rows
+        decode_cost = req.max_tokens
+        if cap is not None:
+            decode_cost = min(
+                req.max_tokens, max(cap - len(route_ids), 0)
+            )
+        self.admission.check_deadline(
+            deadline_s, r.outstanding_tokens(), decode_cost,
+            r.tokens_per_second(),
+        )
+        # quota debits LAST, once nothing further can shed: a request
+        # rejected by the queue/deadline gates was never served, so it
+        # must not burn the tenant's bucket (shed->retry loops would
+        # starve the tenant's feasible traffic). Cost = the work the pool
+        # will actually do: truncated prompt + cache-capped decode.
+        self.admission.check_quota(tenant, len(route_ids) + decode_cost)
+        # capture BEFORE batcher.submit: it assigns an auto id to blank
+        # request_ids, which must not enter the sticky map (auto ids are
+        # per-batcher counters and collide across replicas)
+        task_id = req.request_id
+        handle = r.batcher.submit(req)
+        with self._lock:
+            self._routed[reason] = self._routed.get(reason, 0) + 1
+        self._obs_routed[reason].inc()
+        if reason != "spill":
+            # a one-off overflow must not REBIND the task away from its
+            # cache-holding replica: sticky outranks prefix at select
+            # time, so recording the spill index would pin every later
+            # continuation to the wrong replica after the full one drains
+            self.router.note_routed(task_id, idx)
+        return handle
+
+    def _respawn_dead(self) -> None:
+        with self._lock:
+            for r in self.replicas:
+                if not r.dead():
+                    continue
+                err = r.batcher.last_error
+                log.warning(
+                    "%s replica %d scheduler crashed (%r); respawning its "
+                    "batcher", self.name, r.idx, err,
+                )
+                try:
+                    r.batcher.shutdown()
+                except Exception:  # noqa: BLE001 - old thread may be gone
+                    pass
+                r.batcher = self._spawn_batcher(r.engine)
+                self.restarts += 1
+                self._obs_restarts.inc()
+                if self.on_respawn is not None:
+                    self.on_respawn(r.idx, r.batcher)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting and wait for in-flight streams to finish.
+        Returns True when every replica went idle within ``timeout``."""
+        self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.idle() for r in self.replicas):
+                return True
+            time.sleep(0.02)
+        return all(r.idle() for r in self.replicas)
+
+    def shutdown(self, drain_timeout: float = 0.0) -> None:
+        """Shut every replica down (optionally draining first) and free
+        engine HBM deterministically."""
+        self._draining = True
+        if drain_timeout > 0:
+            self.drain(drain_timeout)
+        self._closed = True
+        for r in self.replicas:
+            r.batcher.shutdown()
+            r.engine.close()
+        # drop the gauge series this pool still owns; a hot-swap
+        # replacement rebound its own indices already (fn differs), and
+        # those must stay
+        for child, fn, remove in getattr(self, "_gauge_bindings", ()):
+            if child._fn is fn:
+                remove()
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        """Pool-level twin of ``engine.stats()``: engine counters summed
+        across replicas, batcher counters, routing/shed tallies. Flat
+        scalars only — HealthCheck renders it as k=v pairs."""
+        out: Dict[str, float] = {
+            "replicas": len(self.replicas),
+            "replica_restarts": self.restarts,
+        }
+        occ = []
+        for r in self.replicas:
+            for k, v in r.engine.stats().items():
+                if k == "batch_occupancy":
+                    occ.append(v)
+                    continue
+                out[k] = out.get(k, 0) + v
+            out["waiting"] = out.get("waiting", 0) + r.queue_depth()
+            out["completed"] = out.get("completed", 0) + r.batcher.completed
+            out["cancelled"] = (
+                out.get("cancelled", 0) + r.batcher.cancellations
+            )
+            out["pool_evictions"] = (
+                out.get("pool_evictions", 0) + r.batcher.pool_evictions
+            )
+            out["num_slots"] = out.get("num_slots", 0) + r.engine.num_slots
+            out[f"replica{r.idx}_occupancy"] = round(r.occupancy(), 3)
+        if occ:
+            out["batch_occupancy"] = round(sum(occ) / len(occ), 3)
+        with self._lock:
+            for reason, n in self._routed.items():
+                out[f"routed_{reason}"] = n
+            for cause, n in self._shed.items():
+                out[f"shed_{cause}"] = n
+        return out

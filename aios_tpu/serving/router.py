@@ -1,0 +1,87 @@
+"""Cache-aware replica selection (SGLang-style, arXiv:2312.07104).
+
+aiOS traffic is shared-prefix by construction: every agent rebuilds its
+prompt from the same system/task preamble each reasoning round. On a
+multi-replica pool the throughput lever is therefore WHERE a request
+lands — the replica already holding the prompt's prefix pages serves it
+with a page-table update instead of a prefill. Selection order:
+
+  1. **sticky** — a ``task_id`` continuation goes back to the replica
+     that served the task before (its whole conversation KV lives there);
+  2. **prefix** — score every replica by prefix-cache overlap with the
+     prompt ids (a read-only peek at the existing
+     ``paged.PrefixIndex`` state — no hit/miss counters touched, no LRU
+     refresh) and take the best one when the overlap covers at least
+     ``overlap_min_ratio`` of the prompt;
+  3. **least_loaded** — otherwise, fewest outstanding tokens (queued
+     prompt+budget plus live remaining budget) wins.
+
+The pool overrides a full chosen replica with the least-loaded one that
+still has queue room (reason ``spill``) before the admission queue-bound
+gate sheds.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+_STICKY_CAPACITY = 4096  # task ids are client input; LRU-bound the map
+
+
+class Router:
+    def __init__(self, overlap_min_ratio: float = 0.25) -> None:
+        self.overlap_min_ratio = overlap_min_ratio
+        self._sticky: "OrderedDict[str, int]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def select(self, replicas: Sequence, prompt_ids: List[int],
+               task_id: str = "", hashes=None) -> Tuple[int, str]:
+        """Pick a replica index for a request. ``replicas`` are
+        Replica-shaped objects (``overlap_rows(ids, hashes=None)``,
+        ``outstanding_tokens()``); returns (index, reason). ``hashes``
+        are the prompt's precomputed block digests — the pool hashes
+        once so N replicas don't each redo the sha256 chain."""
+        if len(replicas) == 1:
+            return 0, "single"
+        sticky = self._sticky_for(task_id, len(replicas))
+        if sticky is not None:
+            return sticky, "sticky"
+        best, best_rows = -1, 0
+        for i, r in enumerate(replicas):
+            rows = r.overlap_rows(prompt_ids, hashes=hashes)
+            if rows > best_rows:
+                best, best_rows = i, rows
+        threshold = max(1, int(len(prompt_ids) * self.overlap_min_ratio))
+        if best >= 0 and best_rows >= threshold:
+            return best, "prefix"
+        return self.least_loaded(replicas), "least_loaded"
+
+    @staticmethod
+    def least_loaded(replicas: Sequence) -> int:
+        return min(
+            range(len(replicas)),
+            key=lambda i: replicas[i].outstanding_tokens(),
+        )
+
+    def _sticky_for(self, task_id: str, n: int) -> Optional[int]:
+        if not task_id:
+            return None
+        with self._lock:
+            idx = self._sticky.get(task_id)
+            if idx is None:
+                return None
+            self._sticky.move_to_end(task_id)
+            # a shrunk pool (failed replica) invalidates the binding
+            return idx if idx < n else None
+
+    def note_routed(self, task_id: str, idx: int) -> None:
+        """Record where a task landed so its continuations stay put."""
+        if not task_id:
+            return
+        with self._lock:
+            self._sticky[task_id] = idx
+            self._sticky.move_to_end(task_id)
+            while len(self._sticky) > _STICKY_CAPACITY:
+                self._sticky.popitem(last=False)

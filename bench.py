@@ -44,6 +44,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 V5E_HBM_GBPS = 819.0  # v5e chip peak HBM bandwidth
@@ -352,6 +353,111 @@ def bench_agent_ttft():
         log(f"[agent-ttft] prefix wave failed: {e!r}")
         result["prefix_wave_error"] = repr(e)[:200]
     return result
+
+
+def bench_replica_pool(replicas: int):
+    """--replicas N: shared-prefix agent waves through the serving
+    ReplicaPool (aios_tpu/serving/) — 8 agents, two tenants, each tenant
+    re-sending its own 512-token preamble. Measures aggregate tok/s AND
+    routing quality: the prefix-routed fraction plus per-replica
+    occupancy (peak while the wave is in flight and final), so a bench
+    run can tell cache-aware routing from round-robin luck."""
+    import jax
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINYLLAMA_1_1B
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.serving import ReplicaPool, ServingConfig
+
+    t0 = time.time()
+    params = model_mod.init_quantized_params(
+        TINYLLAMA_1_1B, jax.random.PRNGKey(0)
+    )
+    engines = []
+    for _ in range(replicas):
+        eng = TPUEngine(
+            TINYLLAMA_1_1B, params, num_slots=8, max_context=1024,
+            paged_pool_rows=8192, page_size=128,
+        )
+        eng.warmup()
+        engines.append(eng)
+    pool = ReplicaPool(
+        "bench-pool", engines, lambda e: ContinuousBatcher(e),
+        ServingConfig(replicas=replicas),
+    )
+    log(f"[replica-pool] {replicas} replicas ready in {time.time() - t0:.1f}s")
+    try:
+        preambles = {  # two tenants, disjoint 512-token system prompts
+            "tenant-a": list(range(3, 515)),
+            "tenant-b": list(range(600, 1112)),
+        }
+        # register each prefix once, CONCURRENTLY: the second submit must
+        # see the first still outstanding so least-loaded spreads the two
+        # tenants across replicas (sequential warms would tie-break both
+        # onto replica 0 and the wave would measure one replica)
+        warm = [
+            pool.submit(
+                Request(prompt_ids=pre + [1], max_tokens=8, temperature=0.0),
+                tenant=tenant,
+            )
+            for tenant, pre in preambles.items()
+        ]
+        for h in warm:
+            h.tokens()
+
+        peak = [0.0] * replicas
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                for i, r in enumerate(pool.replicas):
+                    peak[i] = max(peak[i], r.occupancy())
+                time.sleep(0.02)
+
+        sampler_t = threading.Thread(target=sampler, daemon=True)
+        sampler_t.start()
+        t1 = time.time()
+        handles = []
+        for wave in range(3):
+            for agent in range(8):
+                tenant = ("tenant-a", "tenant-b")[agent % 2]
+                handles.append(pool.submit(
+                    Request(
+                        prompt_ids=preambles[tenant] + [2 + wave, agent],
+                        max_tokens=32, temperature=0.0,
+                    ),
+                    tenant=tenant,
+                ))
+        total_tokens = sum(len(h.tokens()) for h in handles)
+        dt = time.time() - t1
+        stop.set()
+        sampler_t.join(timeout=2)
+        stats = pool.stats()
+        routed = {
+            k.removeprefix("routed_"): int(v)
+            for k, v in stats.items() if k.startswith("routed_")
+        }
+        n_routed = sum(routed.values()) or 1
+        return {
+            "metric": f"replica-pool shared-prefix agent waves "
+                      f"({replicas} replicas, 8 agents, tinyllama int8)",
+            "value": round(total_tokens / dt, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": round(total_tokens / dt / BASELINE_CPU_TPS, 1),
+            "replicas": replicas,
+            "prefix_routed_ratio": round(
+                routed.get("prefix", 0) / n_routed, 3
+            ),
+            "routing": routed,
+            "per_replica_peak_occupancy": [round(p, 3) for p in peak],
+            "per_replica_occupancy": [
+                stats.get(f"replica{i}_occupancy", 0.0)
+                for i in range(replicas)
+            ],
+        }
+    finally:
+        pool.shutdown()
 
 
 def bench_spec_decode():
@@ -865,6 +971,10 @@ def main() -> int:
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="capture an XLA profiler trace of one steady-state "
                          "decode dispatch per config into DIR/<config>/")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="also bench the serving ReplicaPool with N "
+                         "replicas (shared-prefix agent waves; emits "
+                         "prefix-routed ratio + per-replica occupancy)")
     args = ap.parse_args()
 
     if args.virtual_tp:
@@ -926,6 +1036,13 @@ def main() -> int:
     ])
     if args.fast:
         extra = []
+    if args.replicas > 1:
+        # explicit opt-in rides along even in --fast mode
+        def bench_replica_pool_n():
+            return bench_replica_pool(args.replicas)
+
+        bench_replica_pool_n.__name__ = "bench_replica_pool"
+        extra.append(bench_replica_pool_n)
 
     if not probe_backend():
         # bounded-probe exhaustion (wedged tunnel): one parseable

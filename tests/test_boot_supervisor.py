@@ -143,6 +143,9 @@ def test_serving_env_from_boot_config(tmp_path):
         "guided_toolcalls = true\n"
         "quantize = \"1\"\n"
         "mesh = \"dp=2,tp=2\"\n"
+        "replicas = 2\n"
+        "tenant_tokens_per_sec = 500\n"
+        "max_queue = 32\n"
     )
     cfg = load_config(str(cfg_file))
     env = serving_env(cfg)
@@ -154,10 +157,19 @@ def test_serving_env_from_boot_config(tmp_path):
         "AIOS_TPU_JSON_MODE": "force",
         "AIOS_TPU_GUIDED_TOOLCALLS": "1",
         "AIOS_TPU_MESH": "dp=2,tp=2",
+        "AIOS_TPU_REPLICAS": "2",
+        "AIOS_TPU_TENANT_TOKENS_PER_SEC": "500",
+        "AIOS_TPU_MAX_QUEUE": "32",
     }
     defs = default_services(cfg)
     for d in defs.values():
         assert d.env["AIOS_TPU_KV_CACHE"] == "int8"
+
+    # an EXPLICIT max_queue = 0 means unbounded (forwarded as "0"),
+    # while leaving it unset injects nothing (serving default of 64)
+    zero = tmp_path / "zero.toml"
+    zero.write_text("[models]\nmax_queue = 0\n")
+    assert serving_env(load_config(str(zero)))["AIOS_TPU_MAX_QUEUE"] == "0"
 
     # defaults: the paged pool + prefix cache default ON ("auto" sizing);
     # no other knob is injected (AiosConfig() directly; load_config(None)

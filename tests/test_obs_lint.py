@@ -77,3 +77,41 @@ def test_label_names_snake_case_and_bounded():
 def test_help_text_present():
     for m in _catalog():
         assert m.help.strip(), f"{m.name}: empty help text"
+
+
+# -- the serving family (aios_tpu/serving/) --------------------------------
+
+SERVING_EXPECTED = {
+    "aios_tpu_serving_replicas_total": "gauge",
+    "aios_tpu_serving_replica_occupancy_ratio": "gauge",
+    "aios_tpu_serving_routing_decisions_total": "counter",
+    "aios_tpu_serving_shed_total": "counter",
+    "aios_tpu_serving_quota_rejections_total": "counter",
+    "aios_tpu_serving_queue_wait_seconds": "histogram",
+    "aios_tpu_serving_replica_restarts_total": "counter",
+}
+
+
+def test_serving_family_complete_and_typed():
+    """The replica-pool instruments the ISSUE 2 catalog promises exist,
+    with the promised kinds — and any NEW aios_tpu_serving_* metric must
+    be added here (and to docs/SERVING.md) so the family stays reviewed."""
+    serving = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_serving_")
+    }
+    assert serving == SERVING_EXPECTED
+
+
+def test_serving_label_conventions():
+    """Serving labels stay low-cardinality by construction: routing
+    reasons and shed causes are fixed enums (see serving/pool.py); only
+    the quota metric carries the tenant label, and nothing carries both
+    tenant and model (series count = tenants x models would blow the
+    child cap under many co-resident models)."""
+    for m in _catalog():
+        if not m.name.startswith("aios_tpu_serving_"):
+            continue
+        assert not ("tenant" in m.labelnames and "model" in m.labelnames), (
+            f"{m.name}: tenant x model label product is unbounded"
+        )
