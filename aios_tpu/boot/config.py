@@ -49,6 +49,13 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             # prefilling (BASELINE.md <200 ms agent-response target).
             # An integer sets a fixed row budget; 0 = dense slot cache.
             "paged_kv_rows": "auto",
+            # host-RAM spill tier behind the prefix cache: evicted prefix
+            # pages' KV is kept in host memory inside this byte budget
+            # and restored device-side on a later hash-chain hit instead
+            # of re-prefilled ("" / 0 = off; docs/CONFIG.md). The restore
+            # floor skips the tier for chains shorter than N pages.
+            "prefix_host_bytes": "",
+            "host_restore_min_pages": "",
             "speculative": False,    # n-gram speculative decode
             "json_mode": "",         # "force" = reference json_object parity
             "guided_toolcalls": False,  # schema-guided reasoning replies
@@ -204,6 +211,10 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
     # serving defaults apply). max_queue forwards an EXPLICIT 0 too —
     # it means unbounded, not "use the default bound".
     for cfg_key, env_key, zero_ok in (
+        # prefix_host_bytes forwards an EXPLICIT 0 too — it means "host
+        # tier off", overriding a ModelConfig.prefix_host_bytes default
+        ("prefix_host_bytes", "AIOS_TPU_PREFIX_HOST_BYTES", True),
+        ("host_restore_min_pages", "AIOS_TPU_HOST_RESTORE_MIN_PAGES", False),
         ("replicas", "AIOS_TPU_REPLICAS", False),
         ("tenant_tokens_per_sec", "AIOS_TPU_TENANT_TOKENS_PER_SEC", False),
         ("tenant_burst_tokens", "AIOS_TPU_TENANT_BURST_TOKENS", False),

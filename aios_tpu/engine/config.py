@@ -40,6 +40,11 @@ class ModelConfig:
     # engine+batcher replicas behind one cache-aware router. 1 = the
     # single-engine layout; AIOS_TPU_REPLICAS overrides at load time.
     replicas: int = 1
+    # host-RAM spill tier behind the prefix cache (engine/paged.py
+    # HostPageStore): byte budget for evicted prefix pages' KV, restored
+    # device-side on a later hash-chain hit instead of re-prefilled.
+    # 0 = off; AIOS_TPU_PREFIX_HOST_BYTES overrides at load time.
+    prefix_host_bytes: int = 0
 
     @property
     def moe(self) -> bool:

@@ -42,6 +42,11 @@ log = logging.getLogger("aios.engine")
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
+# Live HostPageStores per model name: replica engines share the (model,)
+# label on the aios_tpu_prefix_host_* gauges, so the scrape callbacks sum
+# over this set instead of reporting whichever replica registered last.
+_HOST_STORES_BY_MODEL: Dict[str, object] = {}
+
 
 def _cpu_device():
     from .checkpoint import cpu_device
@@ -205,6 +210,8 @@ class TPUEngine:
         paged_pool_rows: Optional[int] = None,  # physical KV rows -> paged
         page_size: int = 128,
         prefix_cache: Optional[bool] = None,  # None -> on when paged
+        prefix_host_bytes: Optional[int] = None,  # host spill tier budget
+        host_restore_min_pages: Optional[int] = None,  # restore floor
         seq_sharded_cache: bool = False,  # shard KV context axis over sp
         track_history: bool = True,  # device-side token history (spec.py)
     ) -> None:
@@ -505,6 +512,7 @@ class TPUEngine:
                     self.allocator, max_pages=num_pages
                 )
         else:
+            prefix_host_bytes = 0
             k, v = model.init_kv_cache(
                 cfg, num_slots, self.max_context, cache_dtype
             )
@@ -568,8 +576,63 @@ class TPUEngine:
         self._prefill_fns: Dict[int, object] = {}
         self._chunk_fns: Dict[Tuple[int, bool], object] = {}
         self._spec_fns: Dict[Tuple[int, int, int], object] = {}
+        self._restore_fns: Dict[int, object] = {}
         self.decode_steps = 0
         self.prefix_rows_reused = 0
+        self.prefix_rows_restored = 0
+
+        # Host-RAM spill tier behind the prefix cache: HBM evictions copy
+        # their page KV device->host (paged.HostPageStore) instead of
+        # dropping it; a later hash-chain hit restores the pages with a
+        # device_put + scatter instead of a prefill forward pass. The
+        # copy-out runs on a background thread (the engine lock only pays
+        # for enqueuing the device-side gather); restores shorter than
+        # host_restore_min_pages fall through to normal prefill (a short
+        # device_put can lose to recompute).
+        if prefix_host_bytes is None:
+            prefix_host_bytes = getattr(cfg, "prefix_host_bytes", 0)
+        self.host_store: Optional[paged.HostPageStore] = None
+        self.host_restore_min_pages = max(int(host_restore_min_pages or 1), 1)
+        self.host_restore_seconds = 0.0
+        self._obs_restore_hist = None
+        self._spill_q: Optional[object] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        if self.prefix_index is not None and int(prefix_host_bytes) > 0:
+            import queue as _queue
+
+            self.host_store = paged.HostPageStore(int(prefix_host_bytes))
+            # BOUNDED in PAGES: each queued batch pins its materialized
+            # device-side gather copies until the worker lands them in
+            # host RAM, so unbounded spilling would let an eviction burst
+            # transiently hold many pools' worth of extra HBM on a chip
+            # already sized near capacity. Pending pages are capped at
+            # one pool's worth; past that, spills drop (plain eviction).
+            self._spill_q = _queue.Queue()
+            # pending-page counter shared by the engine thread (raise) and
+            # the worker (lower) — int += is a read-modify-write, NOT
+            # GIL-atomic, so it gets its own tiny lock
+            self._spill_pending = 0
+            self._spill_lock = threading.Lock()
+            self._spill_max_pending = max(
+                16, self.allocator.capacity_blocks()
+            )
+            import weakref
+
+            # the worker must NOT root the engine (a bound-method target
+            # would pin params + pool state forever if the engine were
+            # dropped without close()) — it takes the queue/store/lock
+            # directly and the pending counter through a weakref, the
+            # same collectibility pattern as the _register_gauges
+            # closures
+            self._spill_thread = threading.Thread(
+                target=TPUEngine._spill_worker,
+                args=(self._spill_q, self.host_store, self._spill_lock,
+                      weakref.ref(self)),
+                name=f"prefix-host-spill-{cfg.name}",
+                daemon=True,
+            )
+            self._spill_thread.start()
+            self.prefix_index.spill = self._spill_pages
         self.spec_rounds = 0
         self.spec_tokens = 0
         self.spec_slot_rounds = 0
@@ -637,6 +700,39 @@ class TPUEngine:
 
             obs.ENGINE_PREFIX_HITS.labels(model=name).set_function(hits)
             obs.ENGINE_PREFIX_MISSES.labels(model=name).set_function(misses)
+        if self.host_store is not None:
+            # Replica engines share the (model,) label, and set_function
+            # is last-writer-wins — so every replica's callback reads a
+            # shared per-model WeakSet of live stores and reports the SUM,
+            # matching the pool.stats() aggregate. Dead pools drop out of
+            # the set when their engines are collected.
+            stores = _HOST_STORES_BY_MODEL.setdefault(name, weakref.WeakSet())
+            stores.add(self.host_store)
+
+            def store_stat(attr):
+                def read() -> float:
+                    return float(sum(getattr(s, attr) for s in stores))
+
+                return read
+
+            obs.PREFIX_HOST_BYTES.labels(model=name).set_function(
+                store_stat("bytes_resident")
+            )
+            obs.PREFIX_HOST_SPILLS.labels(model=name).set_function(
+                store_stat("spills")
+            )
+            obs.PREFIX_HOST_RESTORES.labels(model=name).set_function(
+                store_stat("restores")
+            )
+            obs.PREFIX_HOST_HITS.labels(model=name).set_function(
+                store_stat("hits")
+            )
+            obs.PREFIX_HOST_MISSES.labels(model=name).set_function(
+                store_stat("misses")
+            )
+            self._obs_restore_hist = obs.PREFIX_HOST_RESTORE_SECONDS.labels(
+                model=name
+            )
 
     # -- jitted cores -------------------------------------------------------
 
@@ -1216,11 +1312,213 @@ class TPUEngine:
                 )
 
     # -- prefix caching (paged engines; paged.PrefixIndex) ------------------
+    # -- + host spill tier (paged.HostPageStore) ----------------------------
+
+    def _spill_pages(self, evicted) -> None:
+        """PrefixIndex eviction hook: capture the evicted pages' KV with a
+        device-side gather, then hand the copies to the spill worker —
+        the device->host transfer and store insert run off the lock.
+
+        The gather is BLOCKED until its buffers materialize, under the
+        engine lock: the pages free (and can be rewritten) the moment
+        this hook returns, and the pool buffer must be clean to donate to
+        the next dispatch — so the lock pays for the in-flight dispatch
+        queue draining plus the gather itself. That cost lands only on
+        eviction paths (pool-pressure admissions and index overflow),
+        where the alternative was a full prefill recompute anyway."""
+        if self.host_store is None or self._spill_q is None:
+            return
+        with self._spill_lock:
+            if self._spill_pending + len(evicted) > self._spill_max_pending:
+                pending = self._spill_pending
+            else:
+                pending = -1
+                self._spill_pending += len(evicted)
+        if pending >= 0:
+            # the worker is behind an eviction burst: drop this spill
+            # BEFORE enqueuing the gather (pending batches pin device
+            # memory) — the evicted pages degrade to plain eviction
+            log.warning(
+                "host-tier spill backlog at %d pages; dropping %d page(s)",
+                pending, len(evicted),
+            )
+            return
+        try:
+            pages = np.asarray([p for _, p in evicted], np.int32)
+            arrs = [self.state["k"][:, pages], self.state["v"][:, pages]]
+            if self.quant_cache:
+                arrs.append(self.state["k_s"][:, pages])
+                arrs.append(self.state["v_s"][:, pages])
+            jax.block_until_ready(arrs)
+        except BaseException:
+            # a failed gather (e.g. RESOURCE_EXHAUSTED materializing the
+            # copies on a full chip) must give its reservation back, or
+            # the leaked count eventually pins the backlog gate shut and
+            # silently disables the tier; _drop's handler degrades this
+            # eviction to a plain one
+            with self._spill_lock:
+                self._spill_pending -= len(evicted)
+            raise
+        self._spill_q.put(([h for h, _ in evicted], arrs))
+
+    @staticmethod
+    def _spill_worker(q, store, lock, eng_ref) -> None:
+        """Daemon loop: device->host copies + HostPageStore inserts for
+        spilled pages. Best-effort — a failed spill degrades that
+        eviction to the pre-host-tier behavior (KV lost, recompute on the
+        next hit), never corrupts. Static on purpose: the thread owns
+        only the queue/store/lock (a close() that times out on a deep
+        backlog must not crash it mid-drain) and reaches the pending
+        counter through ``eng_ref``, so an engine dropped WITHOUT close()
+        stays collectible — the periodic get() timeout notices the dead
+        weakref and exits."""
+        import queue as _queue
+
+        keys = ("k", "v", "k_s", "v_s")
+        while True:
+            try:
+                item = q.get(timeout=60)
+            except _queue.Empty:
+                if eng_ref() is None:
+                    return  # engine collected without close(); wind down
+                continue
+            if item is None:
+                return
+            hashes, arrs = item
+            try:
+                host = [np.asarray(a) for a in arrs]
+                for i, h in enumerate(hashes):
+                    store.put(h, {
+                        k: np.ascontiguousarray(host[j][:, i])
+                        for j, k in enumerate(keys[: len(host)])
+                    })
+            except Exception:  # noqa: BLE001 - spill is best-effort
+                log.exception("host-tier spill worker failed")
+            finally:
+                eng = eng_ref()
+                if eng is not None:
+                    with lock:
+                        eng._spill_pending -= len(hashes)
+
+    def _restore_fn(self, bucket: int):
+        """Jitted per-layer pool scatter for a host-tier restore of up to
+        ``bucket`` pages. Power-of-two buckets bound the compile count;
+        pad entries land on the sacrificial page 0, which is never read.
+
+        Deliberately NOT donated: a restore fires under the same HBM
+        pressure that evicted the pages, and a dispatch-time failure of a
+        donating call can consume the state buffers first — wedging every
+        later dispatch on 'Array has been deleted', strictly worse than
+        the transient pool copy the undonated scatter pays. A failure
+        here instead leaves ``self.state`` intact and the caller falls
+        back to normal prefill."""
+        fn = self._restore_fns.get(bucket)
+        if fn is None:
+            if self.quant_cache:
+                def impl(state, kh, vh, ksh, vsh, pages):
+                    new = dict(state)
+                    new["k"] = state["k"].at[:, pages].set(kh)
+                    new["v"] = state["v"].at[:, pages].set(vh)
+                    new["k_s"] = state["k_s"].at[:, pages].set(ksh)
+                    new["v_s"] = state["v_s"].at[:, pages].set(vsh)
+                    return new
+            else:
+                def impl(state, kh, vh, pages):
+                    new = dict(state)
+                    new["k"] = state["k"].at[:, pages].set(kh)
+                    new["v"] = state["v"].at[:, pages].set(vh)
+                    return new
+            fn = self._instrument_compile(jax.jit(impl), "restore")
+            self._restore_fns[bucket] = fn
+        return fn
+
+    def _restore_from_host(self, slot: int, entries) -> List[int]:
+        """Allocate landing pages for a host-tier chain hit, scatter the
+        stored KV back into the pool, map the pages as ``slot``'s next
+        logical blocks, and re-register their hashes in the HBM index.
+        Returns the new pages — empty when the pool cannot back them
+        (the caller falls back to normal prefill; nothing was touched).
+        Caller holds the engine lock; the scatter dispatch is async, so
+        the copy-in overlaps the request's tail-prefill chunking (any
+        later read orders after it through the state data dependency)."""
+        # clamp the chain to what the pool can PLAUSIBLY back before
+        # allocating: an uncapped alloc_pages would first evict (and
+        # blocking-gather) cold HBM prefix entries via the reclaimer,
+        # then fail on the remaining shortfall anyway — paying the
+        # eviction thrash for a restore that never happens. Truncation
+        # keeps a chain prefix, which is still a valid restore.
+        avail = self.allocator.free_pages_for(slot) \
+            + self.prefix_index.reclaimable()
+        if len(entries) > avail:
+            entries = entries[:avail]
+            if len(entries) < self.host_restore_min_pages:
+                return []
+        try:
+            pages = self.allocator.alloc_pages(len(entries))
+        except paged.PoolExhausted:
+            return []
+        t0 = time.perf_counter()
+        n = len(pages)
+        nb = 1
+        while nb < n:
+            nb *= 2
+        pad = np.zeros(nb, np.int32)  # pad rows -> sacrificial page 0
+        pad[:n] = pages
+
+        def stacked(key):
+            a = np.stack([e[key] for _, e in entries], axis=1)
+            if nb > n:
+                shape = list(a.shape)
+                shape[1] = nb - n
+                a = np.concatenate(
+                    [a, np.zeros(shape, a.dtype)], axis=1
+                )
+            return jnp.asarray(a)
+
+        try:
+            args = [stacked("k"), stacked("v")]
+            if self.quant_cache:
+                args += [stacked("k_s"), stacked("v_s")]
+            self.state = self._restore_fn(nb)(
+                self.state, *args, jnp.asarray(pad)
+            )
+        except BaseException:
+            # staging or the scatter dispatch failed (a restore fires
+            # exactly under the HBM pressure that evicted these pages, so
+            # RESOURCE_EXHAUSTED here is plausible): give the allocated
+            # pages back — leaking them at refcount 1 would shrink the
+            # pool forever — and fall back to normal prefill
+            for p in pages:
+                self.allocator.decref(p)
+            log.exception(
+                "host-tier restore failed; recomputing %d page(s)", n
+            )
+            return []
+        dt = time.perf_counter() - t0
+        self.host_restore_seconds += dt
+        if self._obs_restore_hist is not None:
+            self._obs_restore_hist.observe(dt)
+        self.allocator.append_owned(slot, pages)
+        hashes = [h for h, _ in entries]
+        # back in HBM: re-register so the NEXT prompt maps these pages
+        # directly, and drop the host copies (they respill on eviction)
+        self.prefix_index.put(hashes, pages)
+        self.host_store.discard(hashes, restored=True)
+        self.prefix_rows_restored += n * self.allocator.page_size
+        return pages
 
     def _match_prefix(self, slot: int, ids: List[int]):
         """Map the longest hash-matched prompt prefix into ``slot``'s page
-        table (shared, read-only) and backfill its token history. Returns
-        (matched_rows, block_hashes). Caller holds the engine lock.
+        table and backfill its token history. HBM-resident blocks map as
+        shared read-only pages (zero compute, zero new pages); when the
+        hash chain continues into the host spill tier — and the run
+        clears ``host_restore_min_pages`` — fresh pages are allocated and
+        the stored KV scatters back in: a memcpy instead of a prefill
+        forward pass. Restored pages get the same read-only guarantee by
+        the same construction (matches cap at the prompt's last full
+        block minus one row, so every tail/decode write lands past them).
+        Returns (matched_rows, block_hashes). Caller holds the engine
+        lock.
 
         matched_rows is page-aligned but NOT chunk-aligned — the tail's
         chunk starts inherit the misalignment, which the chunk writers are
@@ -1234,11 +1532,24 @@ class TPUEngine:
             return 0, []
         hashes = paged.chain_hashes(ids, P, full)
         pages = self.prefix_index.match(hashes)
-        if not pages:
+        entries = []
+        if self.host_store is not None and len(pages) < full:
+            entries = self.host_store.match_chain(hashes[len(pages) :])
+            if len(entries) < self.host_restore_min_pages:
+                entries = []  # below the floor: recompute beats device_put
+        if not pages and not entries:
             return 0, hashes
-        self.allocator.map_shared(slot, pages)
-        matched = len(pages) * P
-        self.prefix_rows_reused += matched
+        if pages:
+            # map the HBM hits FIRST: their index references alone are
+            # reclaimable (refcount 1), so taking the slot reference
+            # before the restore's alloc_pages keeps a pressure-reclaim
+            # from freeing the very pages this prompt just matched
+            self.allocator.map_shared(slot, pages)
+            self.prefix_rows_reused += len(pages) * P
+        restored = self._restore_from_host(slot, entries) if entries else []
+        matched = (len(pages) + len(restored)) * P
+        if not matched:
+            return 0, hashes
         # the n-gram proposer reads history[0:length] — backfill the
         # shared region (padding past `matched` inside the last segment's
         # bucket is overwritten by the tail chunks writing [matched, len))
@@ -1279,16 +1590,27 @@ class TPUEngine:
         holds — the serving router's cache-aware score. Read-only: no
         hit/miss counters move, no LRU refresh, no pages map (scoring N
         replicas per request must not perturb the index), and it takes
-        only the index's own lock — never the dispatch lock, so a replica
-        mid-dispatch (or mid-compile) cannot stall routing. 0 on
-        non-paged engines or when no full block matches."""
+        only the index's (and host store's) own locks — never the
+        dispatch lock, so a replica mid-dispatch (or mid-compile) cannot
+        stall routing. Rows resident only in the host spill tier count at
+        ``paged.HOST_OVERLAP_DISCOUNT`` — routing still prefers true HBM
+        residency but credits a replica that can restore the prefix with
+        a memcpy over one that must recompute it. 0 on non-paged engines
+        or when no full block matches."""
         if self.prefix_index is None:
             return 0
         if hashes is None:
             hashes = self.prefix_hashes(token_ids)
         if not hashes:
             return 0
-        return self.prefix_index.peek(hashes) * self.allocator.page_size
+        P = self.allocator.page_size
+        n_hbm = self.prefix_index.peek(hashes)
+        rows = n_hbm * P
+        if self.host_store is not None and n_hbm < len(hashes):
+            n_host = self.host_store.peek_chain(hashes[n_hbm:])
+            if n_host >= self.host_restore_min_pages:
+                rows += int(n_host * P * paged.HOST_OVERLAP_DISCOUNT)
+        return rows
 
     # -- public API ---------------------------------------------------------
 
@@ -1557,6 +1879,16 @@ class TPUEngine:
             out["prefix_hits"] = self.prefix_index.hits
             out["prefix_misses"] = self.prefix_index.misses
             out["prefix_rows_reused"] = self.prefix_rows_reused
+        if self.host_store is not None:
+            s = self.host_store
+            out["prefix_rows_restored"] = self.prefix_rows_restored
+            out["host_tier_bytes"] = s.bytes_resident
+            out["host_tier_capacity_bytes"] = s.max_bytes
+            out["host_tier_spills"] = s.spills
+            out["host_tier_restores"] = s.restores
+            out["host_tier_hits"] = s.hits
+            out["host_tier_misses"] = s.misses
+            out["host_tier_restore_s"] = round(self.host_restore_seconds, 3)
         return out
 
     def close(self) -> None:
@@ -1569,11 +1901,30 @@ class TPUEngine:
         this)."""
         import gc
 
+        if self._spill_q is not None:
+            # stop accepting spills, then drain + stop the worker BEFORE
+            # dropping the state (its queued items hold materialized
+            # gather results, independent of the pool buffer). _spill_q
+            # itself stays set: a worker that outlives the join (deep
+            # backlog) drains through its local reference and exits on
+            # the sentinel — nulling it would crash the worker mid-drain.
+            if self.prefix_index is not None:
+                self.prefix_index.spill = None
+            self._spill_q.put(None)
+            if self._spill_thread is not None:
+                self._spill_thread.join(timeout=5)
+            self._spill_thread = None
+        if self.host_store is not None:
+            # after the worker exited this empties the store for good; on
+            # a timed-out join the straggler's late inserts are bounded
+            # by the store budget and freed when the engine is collected
+            self.host_store.clear()
         with self._lock:
             self._step_fns.clear()
             self._prefill_fns.clear()
             self._chunk_fns.clear()
             self._spec_fns.clear()
+            self._restore_fns.clear()
             self.state = {}
             self.params = None
             self._attn_impl = None
@@ -1608,21 +1959,38 @@ class TPUEngine:
         Prefix matching is suspended for the duration: warmup's synthetic
         prompts must compile every monolithic prefill bucket, and a
         self-match would short-circuit the larger buckets onto the chunked
-        path (and pollute the index with junk blocks).
+        path (and pollute the index with junk blocks). The host-tier
+        spill hook is detached for the same reason — a pressure reclaim
+        during warmup admissions must not demote synthetic blocks into
+        the host store.
         """
         prefix_index, self.prefix_index = self.prefix_index, None
+        spill = None
+        if prefix_index is not None and prefix_index.spill is not None:
+            spill, prefix_index.spill = prefix_index.spill, None
         try:
-            self._warmup_graphs(step_sizes, prefill_chunk)
-            if masked_step:  # json-mode deployments dispatch step_masked
-                self.step_masked(
-                    np.zeros(
-                        (self.num_slots, self.cfg.vocab_size), np.float32
+            try:
+                self._warmup_graphs(step_sizes, prefill_chunk)
+                if masked_step:  # json-mode deployments dispatch step_masked
+                    self.step_masked(
+                        np.zeros(
+                            (self.num_slots, self.cfg.vocab_size), np.float32
+                        )
                     )
-                )
+            finally:
+                self.prefix_index = prefix_index
+            if self.prefix_index is not None:
+                self._warmup_prefix_graphs()
+                self._warmup_restore_graphs()
         finally:
-            self.prefix_index = prefix_index
-        if self.prefix_index is not None:
-            self._warmup_prefix_graphs()
+            # ONE finally covers every phase: a caller that survives a
+            # warmup failure and keeps serving must not end up with the
+            # spill hook silently detached (a dead host tier for the
+            # process lifetime) or warmup junk resident in the store
+            if spill is not None:
+                prefix_index.spill = spill
+            if self.host_store is not None:
+                self.host_store.clear()
 
     def _warmup_prefix_graphs(self) -> None:
         """Compile everything a prefix HIT can dispatch — the
@@ -1649,6 +2017,47 @@ class TPUEngine:
             self.prefill(0, [7] * n, temperature=0.0)
             self.release(0)
         self.prefix_index.clear()  # drop the synthetic warmup blocks
+
+    def _warmup_restore_graphs(self) -> None:
+        """Compile the host-tier restore scatters (every power-of-two
+        page bucket the pool can hold) behind the readiness gate, so the
+        first spill->restore cycle mid-serving doesn't stall live
+        requests on an XLA compile. The warmup writes land on the
+        sacrificial page 0, which is never read."""
+        if self.host_store is None:
+            return
+        P = self.allocator.page_size
+        cfg = self.cfg
+        # a restore chain is bounded by the prompt's full blocks, NOT the
+        # pool: capping at capacity alone would compile (and transiently
+        # allocate zero-KV staging buffers for) buckets far bigger than
+        # any restore can request on an auto-sized pool
+        cap = min(
+            self.allocator.capacity_blocks(),
+            (self.max_context - 1) // P,
+        )
+        nb = 1
+        while True:
+            pages = jnp.zeros((nb,), jnp.int32)
+            z = jnp.zeros(
+                (cfg.num_layers, nb, P, cfg.num_kv_heads, cfg.head_dim),
+                self.state["k"].dtype,
+            )
+            args = [z, z]
+            if self.quant_cache:
+                s = jnp.zeros(
+                    (cfg.num_layers, nb, P, cfg.num_kv_heads), jnp.float32
+                )
+                args += [s, s]
+            with self._lock:
+                self.state = self._restore_fn(nb)(self.state, *args, pages)
+            if nb >= cap:
+                # a restore can round up to the first power of two AT or
+                # ABOVE capacity (e.g. 10 pages -> bucket 16 on a 15-page
+                # pool) — stopping at nb <= cap would leave exactly that
+                # largest bucket to compile mid-serving
+                break
+            nb *= 2
 
     def _warmup_graphs(self, step_sizes, prefill_chunk) -> None:
         for bucket in self.buckets:

@@ -21,12 +21,22 @@ slots x context on a TPU chip (SURVEY.md section 7.2, hard part no. 1).
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+log = logging.getLogger("aios.paged")
+
 SACRIFICIAL_PAGE = 0
+
+# How the serving router scores prefix rows that are only host-resident:
+# a restorable prefix saves the prefill compute but still pays alloc +
+# device_put + scatter, so it is worth less than true HBM residency —
+# routing prefers the replica with the pages already on chip and falls
+# back to the one that can at least restore them.
+HOST_OVERLAP_DISCOUNT = 0.5
 
 
 class PoolExhausted(RuntimeError):
@@ -154,6 +164,43 @@ class PageAllocator:
             self.tables[slot, b] = page
         self._blocks_used[slot] = len(pages)
 
+    def alloc_pages(self, n: int, replica: int = 0) -> List[int]:
+        """Pop ``n`` fresh pages (refcount 1 each) WITHOUT mapping them to
+        a slot — the host-tier restore path allocates its landing pages
+        here, scatters the stored KV in, then maps them via
+        ``append_owned``. Raises PoolExhausted (after asking the
+        reclaimer) with nothing allocated."""
+        self._take(n, replica)
+        out: List[int] = []
+        for _ in range(n):
+            page = self._free[replica].pop()
+            self._rc[replica, page] = 1
+            out.append(page)
+        return out
+
+    def append_owned(self, slot: int, pages: Sequence[int]) -> None:
+        """Map already-allocated pages (references taken by
+        ``alloc_pages``) as ``slot``'s next logical blocks — they extend a
+        ``map_shared`` prefix, so no extra reference is taken here."""
+        start = int(self._blocks_used[slot])
+        for b, page in enumerate(pages, start=start):
+            self.tables[slot, b] = page
+        self._blocks_used[slot] = start + len(pages)
+
+    def refcount(self, page: int, replica: int = 0) -> int:
+        """Public read of a page's reference count (0 = on the free
+        list) — the supported accessor for policy code like
+        ``PrefixIndex.reclaim`` that must know whether a page is held
+        only by the index."""
+        return int(self._rc[replica, page])
+
+    def refcounts(self, pages, replica: int = 0) -> np.ndarray:
+        """Vectorized :meth:`refcount` over an array of page ids — one
+        numpy gather instead of a Python loop of scalar reads, for policy
+        code that scans many pages under a lock (``PrefixIndex.
+        reclaimable``)."""
+        return self._rc[replica, np.asarray(pages, dtype=np.int64)]
+
     def incref(self, page: int, replica: int = 0) -> None:
         self._rc[replica, page] += 1
 
@@ -227,6 +274,116 @@ def chain_hashes(
     return hashes
 
 
+class HostPageStore:
+    """Host-RAM spill tier behind the prefix cache (hash -> page KV bytes).
+
+    Every HBM eviction from the :class:`PrefixIndex` — LRU past
+    ``max_pages`` or the allocator's ``reclaim()`` under pool pressure —
+    used to throw the computed KV away; with a store configured
+    (``AIOS_TPU_PREFIX_HOST_BYTES`` / ``ModelConfig.prefix_host_bytes``)
+    the page's contents are copied device->host here instead, and a later
+    prompt whose hash chain misses HBM but hits this tier restores them
+    with a ``device_put`` + scatter instead of a prefill forward pass.
+    Host RAM is orders of magnitude larger than the HBM slack the index
+    can hold, so this multiplies effective prefix capacity (RTP-LLM's
+    multi-tier KV cache, PAPERS.md).
+
+    Entries are numpy arrays keyed by the same chain hash the index uses;
+    the byte budget is enforced by LRU eviction. The store has its own
+    lock: the spill worker writes from its background thread, the engine
+    reads under its dispatch lock, and the serving router peeks without
+    either."""
+
+    def __init__(self, max_bytes: int) -> None:
+        import threading
+
+        self.max_bytes = int(max_bytes)
+        self._entries: "OrderedDict[bytes, Dict[str, np.ndarray]]" = (
+            OrderedDict()
+        )
+        self.bytes_resident = 0
+        self.spills = 0  # entries accepted from HBM evictions
+        self.restores = 0  # entries promoted back into pool pages
+        self.hits = 0  # restore probes that found >= 1 entry
+        self.misses = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _entry_bytes(entry: Dict[str, np.ndarray]) -> int:
+        return sum(int(a.nbytes) for a in entry.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def put(self, h: bytes, entry: Dict[str, np.ndarray]) -> None:
+        """Insert a spilled page (the newest entry; LRU evicts past the
+        byte budget). An entry bigger than the whole budget is dropped."""
+        nb = self._entry_bytes(entry)
+        if nb > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(h, None)
+            if old is not None:
+                self.bytes_resident -= self._entry_bytes(old)
+            self._entries[h] = entry
+            self.bytes_resident += nb
+            self.spills += 1
+            while self.bytes_resident > self.max_bytes and self._entries:
+                _, dropped = self._entries.popitem(last=False)
+                self.bytes_resident -= self._entry_bytes(dropped)
+
+    def match_chain(
+        self, hashes: Sequence[bytes]
+    ) -> List[Tuple[bytes, Dict[str, np.ndarray]]]:
+        """Longest stored prefix of ``hashes`` (LRU refreshed, hit/miss
+        counted once per probe). Entries stay resident until the caller
+        confirms the restore with ``discard`` — a failed restore (pool
+        exhausted mid-allocation) must not lose the spilled KV."""
+        out: List[Tuple[bytes, Dict[str, np.ndarray]]] = []
+        with self._lock:
+            for h in hashes:
+                e = self._entries.get(h)
+                if e is None:
+                    break
+                self._entries.move_to_end(h)
+                out.append((h, e))
+            if out:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return out
+
+    def peek_chain(self, hashes: Sequence[bytes]) -> int:
+        """Length of the longest stored prefix WITHOUT touching LRU order
+        or the hit/miss counters — the serving router's read-only overlap
+        probe (same contract as ``PrefixIndex.peek``)."""
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._entries:
+                    break
+                n += 1
+        return n
+
+    def discard(self, hashes: Sequence[bytes], *, restored: bool = False
+                ) -> None:
+        """Drop entries (restore promotion, or invalidation). With
+        ``restored`` the restore counter moves."""
+        with self._lock:
+            for h in hashes:
+                e = self._entries.pop(h, None)
+                if e is not None:
+                    self.bytes_resident -= self._entry_bytes(e)
+                    if restored:
+                        self.restores += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.bytes_resident = 0
+
+
 class PrefixIndex:
     """Content-addressed cache of prompt-prefix pages (hash -> page).
 
@@ -240,6 +397,19 @@ class PrefixIndex:
     entries. Shared pages are read-only BY CONSTRUCTION: matches are capped
     at the prompt's last full block minus one row, so every write a slot
     performs (tail prefill, decode) lands at rows past the shared region.
+
+    Hashes are the ``bytes`` sha256 digests of :func:`chain_hashes`,
+    end-to-end — the engine's ``_match_prefix``/``prefix_hashes`` and the
+    serving router's overlap probes all trade in the same digest chain.
+
+    ``spill`` (set by the engine when a :class:`HostPageStore` is
+    configured) is called with the evicted ``[(hash, page), ...]`` pairs
+    BEFORE their index references drop, outside the index lock — the
+    engine captures the pages' device contents there, so an eviction
+    becomes a host-tier demotion instead of a loss. The hook runs under
+    the engine dispatch lock (both eviction paths are reached from
+    lock-holding callers), which is what keeps the page contents stable
+    until the capture is enqueued.
     """
 
     def __init__(self, allocator: PageAllocator, max_pages: int) -> None:
@@ -254,9 +424,15 @@ class PrefixIndex:
 
         self.alloc = allocator
         self.max_pages = max_pages
-        self._index: "OrderedDict[int, int]" = OrderedDict()  # hash -> page
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()  # hash -> page
         self.hits = 0
         self.misses = 0
+        # host-tier demotion hook: called with evicted (hash, page) pairs
+        # before their references drop (see class docstring); None keeps
+        # the pre-host-tier behavior (evictions just free the pages)
+        self.spill: Optional[
+            Callable[[List[Tuple[bytes, int]]], None]
+        ] = None
         # the index carries its OWN lock (not the engine dispatch lock):
         # the serving router peeks it per incoming request, and a probe
         # that had to wait for an in-flight decode dispatch — or a
@@ -265,7 +441,7 @@ class PrefixIndex:
         self._lock = threading.Lock()
         allocator.reclaimer = self.reclaim
 
-    def match(self, hashes: Sequence[int]) -> List[int]:
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
         """Longest indexed prefix of ``hashes``; returns its pages (LRU
         positions refreshed). No references are taken — the caller maps
         them via ``PageAllocator.map_shared`` under the engine lock."""
@@ -297,9 +473,11 @@ class PrefixIndex:
                 n += 1
         return n
 
-    def put(self, hashes: Sequence[int], pages: Sequence[int]) -> None:
-        """Register freshly computed prefix blocks (one index reference
-        each); evicts LRU entries past ``max_pages``."""
+    def put(self, hashes: Sequence[bytes], pages: Sequence[int]) -> None:
+        """Register freshly computed (or host-restored) prefix blocks, one
+        index reference each; LRU entries past ``max_pages`` are evicted —
+        spilled to the host tier first when a ``spill`` hook is set."""
+        evicted: List[Tuple[bytes, int]] = []
         with self._lock:
             for h, page in zip(hashes, pages):
                 if h in self._index:
@@ -308,28 +486,73 @@ class PrefixIndex:
                 self.alloc.incref(page)
                 self._index[h] = page
             while len(self._index) > self.max_pages:
-                _, old = self._index.popitem(last=False)
-                self.alloc.decref(old)
+                evicted.append(self._index.popitem(last=False))
+        self._drop(evicted)
 
     def clear(self) -> None:
-        """Drop every entry (and its page reference)."""
+        """Drop every entry (and its page reference) WITHOUT spilling —
+        the warmup/shutdown path, where the cached blocks are synthetic
+        junk that must not pollute the host tier."""
         with self._lock:
             while self._index:
                 _, page = self._index.popitem(last=False)
                 self.alloc.decref(page)
 
+    def reclaimable(self) -> int:
+        """How many entries ``reclaim`` could free right now (pages held
+        ONLY by the index, refcount 1). The restore path pre-clamps its
+        chain to free + reclaimable so a chain the pool can't back
+        doesn't evict cold HBM entries just to fail anyway."""
+        with self._lock:
+            if not self._index:
+                return 0
+            pages = np.fromiter(
+                self._index.values(), dtype=np.int64, count=len(self._index)
+            )
+            return int(np.count_nonzero(self.alloc.refcounts(pages) == 1))
+
     def reclaim(self, n: int) -> int:
         """Drop up to ``n`` cold entries whose pages are held ONLY by the
-        index (rc 1) — called by the allocator when the free list runs
-        dry. Entries still shared by live slots are left alone."""
-        freed = 0
+        index (refcount 1) — called by the allocator when the free list
+        runs dry. Entries still shared by live slots are left alone.
+        Dropped pages spill to the host tier (hook set) before they free,
+        so pool pressure demotes the cold prefix KV instead of burning
+        it."""
+        evicted: List[Tuple[bytes, int]] = []
         with self._lock:
             for h in list(self._index):
-                if freed >= n:
+                if len(evicted) >= n:
                     break
                 page = self._index[h]
-                if self.alloc._rc[0, page] == 1:
+                if self.alloc.refcount(page) == 1:
                     del self._index[h]
-                    self.alloc.decref(page)
-                    freed += 1
-        return freed
+                    evicted.append((h, page))
+        self._drop(evicted)
+        return len(evicted)
+
+    def _drop(self, evicted: List[Tuple[bytes, int]]) -> None:
+        """Spill evicted entries (hook set), then release their page
+        references. Runs OUTSIDE the index lock — the spill hook enqueues
+        device reads and the router's ``peek`` must not wait on them; the
+        allocator mutation is safe because both eviction paths are
+        reached from engine-lock-holding callers. References drop only
+        AFTER the spill captured the contents, so a freed page can't be
+        reallocated and overwritten mid-copy."""
+        if not evicted:
+            return
+        try:
+            if self.spill is not None:
+                try:
+                    self.spill(evicted)
+                except Exception:  # noqa: BLE001 - degrade to plain evict
+                    log.exception(
+                        "host-tier spill failed; dropping %d page(s)",
+                        len(evicted),
+                    )
+        finally:
+            # the references drop even if the spill dies with a
+            # BaseException (KeyboardInterrupt mid-gather): these entries
+            # are already out of the index, so skipping the decref would
+            # leak their pages for the process lifetime
+            for _, page in evicted:
+                self.alloc.decref(page)

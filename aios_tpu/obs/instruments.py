@@ -112,7 +112,7 @@ ENGINE_POOL_EVICTIONS = Counter(
 )
 ENGINE_XLA_COMPILES = Counter(
     "aios_tpu_engine_xla_compiles_total",
-    "XLA graph builds by kind (step|masked|prefill|chunk|spec|hist)",
+    "XLA graph builds by kind (step|masked|prefill|chunk|spec|hist|restore)",
     ("model", "kind"),
 )
 ENGINE_XLA_COMPILE_SECONDS = Histogram(
@@ -120,6 +120,46 @@ ENGINE_XLA_COMPILE_SECONDS = Histogram(
     "First-dispatch wall time of each new XLA graph (trace+compile stall)",
     ("model", "kind"),
     buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0),
+)
+
+# -- prefix-cache host spill tier (engine/paged.py HostPageStore) ----------
+# Monotonic store counters surface as count-valued gauges read at scrape
+# time (the ENGINE_PREFIX_* pattern); only the restore latency is a true
+# histogram observed on the restore path.
+
+PREFIX_HOST_BYTES = Gauge(
+    "aios_tpu_prefix_host_resident_bytes",
+    "Host-RAM bytes holding spilled prefix-page KV (scrape-time)",
+    ("model",),
+)
+PREFIX_HOST_SPILLS = Gauge(
+    "aios_tpu_prefix_host_spills_total",
+    "Prefix pages spilled device->host on HBM eviction (monotonic)",
+    ("model",),
+)
+PREFIX_HOST_RESTORES = Gauge(
+    "aios_tpu_prefix_host_restores_total",
+    "Prefix pages restored host->device into fresh pool pages (monotonic)",
+    ("model",),
+)
+PREFIX_HOST_HITS = Gauge(
+    "aios_tpu_prefix_host_hits_total",
+    "Host-tier chain probes that found at least one spilled page "
+    "(monotonic)",
+    ("model",),
+)
+PREFIX_HOST_MISSES = Gauge(
+    "aios_tpu_prefix_host_misses_total",
+    "Host-tier chain probes that found nothing (monotonic)",
+    ("model",),
+)
+PREFIX_HOST_RESTORE_SECONDS = Histogram(
+    "aios_tpu_prefix_host_restore_seconds",
+    "Host-side wall time to stage + dispatch one host->device prefix "
+    "restore (the scatter itself is async and overlaps tail prefill)",
+    ("model",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0),
 )
 
 # -- runtime service -------------------------------------------------------

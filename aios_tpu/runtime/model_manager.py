@@ -280,6 +280,41 @@ class ModelManager:
                     "AIOS_TPU_PAGED_KV=%r ignored (expected a positive "
                     "row count, 'auto', or 0/off)", paged_env,
                 )
+        # AIOS_TPU_PREFIX_HOST_BYTES gives the prefix cache a host-RAM
+        # spill tier (engine/paged.py HostPageStore): evicted prefix
+        # pages' KV copies device->host inside this byte budget and
+        # restores with a device_put + scatter on a later hash-chain hit
+        # — a memcpy instead of a prefill recompute. Unset defers to
+        # ModelConfig.prefix_host_bytes (0 = off); 0 forces it off.
+        self.prefix_host_bytes: Optional[int] = None
+        host_env = os.environ.get("AIOS_TPU_PREFIX_HOST_BYTES", "")
+        if host_env:
+            try:
+                v = int(float(host_env))
+                if v < 0:
+                    raise ValueError("must be >= 0")
+                self.prefix_host_bytes = v
+            except ValueError:
+                log.warning(
+                    "AIOS_TPU_PREFIX_HOST_BYTES=%r ignored (expected a "
+                    "non-negative byte count)", host_env,
+                )
+        # AIOS_TPU_HOST_RESTORE_MIN_PAGES floors the restore path: a
+        # host-tier chain shorter than this many pages prefills normally
+        # (device_put of a short prefix can lose to recompute). Default 1.
+        self.host_restore_min_pages: Optional[int] = None
+        floor_env = os.environ.get("AIOS_TPU_HOST_RESTORE_MIN_PAGES", "")
+        if floor_env:
+            try:
+                v = int(float(floor_env))
+                if v < 1:
+                    raise ValueError("must be >= 1")
+                self.host_restore_min_pages = v
+            except ValueError:
+                log.warning(
+                    "AIOS_TPU_HOST_RESTORE_MIN_PAGES=%r ignored (expected "
+                    "an integer >= 1)", floor_env,
+                )
         # sp > 1 in the mesh no longer disables paging wholesale: the pool
         # replicates over sp, and the per-model HBM-budget check at load
         # time degrades only the models that actually need their context
@@ -408,10 +443,21 @@ class ModelManager:
                 prefix = os.environ.get(
                     "AIOS_TPU_PREFIX_CACHE", "1"
                 ).lower() not in ("0", "false", "off")
+                # host spill tier: env wins over the model config (the
+                # convention everywhere); both resolve HERE so the
+                # HealthCheck host-tier occupancy keys and the engine
+                # agree on whether the tier exists
+                host_bytes = self.prefix_host_bytes
+                if host_bytes is None:
+                    host_bytes = cfg.prefix_host_bytes
+                tier_kw = dict(
+                    prefix_host_bytes=host_bytes,
+                    host_restore_min_pages=self.host_restore_min_pages,
+                )
                 if ctx % 128 == 0:
                     kw = dict(
                         paged_pool_rows=pool_rows, page_size=128,
-                        prefix_cache=prefix,
+                        prefix_cache=prefix, **tier_kw,
                     )
                 elif ctx % 16 == 0 and cache_dtype != jnp.int8:
                     # the int8 paged kernel needs 128-aligned pages
@@ -420,7 +466,7 @@ class ModelManager:
                     # conflicts, not as a load-time kernel ValueError
                     kw = dict(
                         paged_pool_rows=pool_rows, page_size=16,
-                        prefix_cache=prefix,
+                        prefix_cache=prefix, **tier_kw,
                     )
                 else:
                     log.warning(

@@ -12,7 +12,11 @@ with a page-table update instead of a prefill. Selection order:
      prompt ids (a read-only peek at the existing
      ``paged.PrefixIndex`` state — no hit/miss counters touched, no LRU
      refresh) and take the best one when the overlap covers at least
-     ``overlap_min_ratio`` of the prompt;
+     ``overlap_min_ratio`` of the prompt. Rows resident only in a
+     replica's host spill tier (``paged.HostPageStore``) count at
+     ``paged.HOST_OVERLAP_DISCOUNT``: a restorable prefix is a memcpy,
+     not free, so routing still prefers true HBM residency but credits
+     the replica that can restore over one that must recompute;
   3. **least_loaded** — otherwise, fewest outstanding tokens (queued
      prompt+budget plus live remaining budget) wins.
 
@@ -37,12 +41,14 @@ class Router:
         self._lock = threading.Lock()
 
     def select(self, replicas: Sequence, prompt_ids: List[int],
-               task_id: str = "", hashes=None) -> Tuple[int, str]:
+               task_id: str = "",
+               hashes: Optional[List[bytes]] = None) -> Tuple[int, str]:
         """Pick a replica index for a request. ``replicas`` are
         Replica-shaped objects (``overlap_rows(ids, hashes=None)``,
         ``outstanding_tokens()``); returns (index, reason). ``hashes``
-        are the prompt's precomputed block digests — the pool hashes
-        once so N replicas don't each redo the sha256 chain."""
+        are the prompt's precomputed block digests (the ``bytes`` sha256
+        chain of ``paged.chain_hashes``) — the pool hashes once so N
+        replicas don't each redo the sha256 chain."""
         if len(replicas) == 1:
             return 0, "single"
         sticky = self._sticky_for(task_id, len(replicas))

@@ -387,6 +387,48 @@ def test_gateway_disconnect_while_queued_cancels_without_slot(monkeypatch):
     mgr = ModelManager(num_slots=1, warm_compile=False)
     try:
         mgr.load_model("tiny", "synthetic://tiny-test", context_length=8192)
+        # DEFLAKE: the hog must NOT retire while the disconnect is in
+        # flight, or the freed slot admits the queued request and the
+        # active_count==1 assert races. Two stochastic retirements
+        # existed: sampling the EOS stop id at temperature 0.5 (the
+        # random-init model emits it eventually — the dominant flake),
+        # and hitting the ctx cap / max_tokens on a fast host. Pin both:
+        # every decode dispatch is throttled (the hog cannot burn its
+        # budget inside any test deadline) and the hog's sampled EOS is
+        # rewritten to a benign token, so only its explicit cancel can
+        # end it. The first token still flows instantly (it comes from
+        # prefill). Budgets are pinned LOW below (3000/512, not 50k) and
+        # the observed decode rate is pinned HIGH: the gateway's local
+        # stream carries a 300 s gRPC deadline, and the admission
+        # feasibility gate ((outstanding + decode_cost) / observed
+        # tok/s) otherwise sheds the queued request whenever the first
+        # rate window lands before it — with warm_compile=False that
+        # window is compile-polluted (~3 tok/s), so the seed test only
+        # passed when "queued" won the race against the first
+        # measurement. Feasibility is not what this test is about.
+        import numpy as np
+
+        eng = mgr.models["tiny"].engine
+        eos = mgr.models["tiny"].tokenizer.eos_id
+        real_step, real_prefill = eng.step, eng.prefill
+
+        def never_stopping_step(n=1):
+            time.sleep(0.2)
+            toks = np.array(real_step(n))
+            toks[toks == eos] = 7
+            return toks
+
+        def never_stopping_prefill(slot, ids, temperature=0.0, top_p=1.0):
+            first = real_prefill(slot, ids, temperature, top_p)
+            if first == eos:
+                eng.force_pending_token(slot, 7)
+                first = 7
+            return first
+
+        monkeypatch.setattr(eng, "step", never_stopping_step)
+        monkeypatch.setattr(eng, "prefill", never_stopping_prefill)
+        batcher0 = mgr.models["tiny"].batcher
+        monkeypatch.setattr(batcher0, "tokens_per_second", lambda: 500.0)
         rt_server, _, rt_port = serve_runtime(
             address="127.0.0.1:0", manager=mgr, block=False
         )
@@ -403,12 +445,12 @@ def test_gateway_disconnect_while_queued_cancels_without_slot(monkeypatch):
 
         # occupy the ONLY slot directly on the runtime
         hog = rt.StreamInfer(runtime_pb2.InferRequest(
-            prompt="hog", max_tokens=50_000, temperature=0.5
+            prompt="hog", max_tokens=3000, temperature=0.5
         ))
         next(hog)
         # gateway request queues behind it (no delta can flow)
         queued = gw.StreamInfer(api_gateway_pb2.ApiInferRequest(
-            prompt="queued", max_tokens=50_000, temperature=0.5
+            prompt="queued", max_tokens=512, temperature=0.5
         ))
         deadline = time.time() + 10
         while batcher.queue_depth() < 1 and time.time() < deadline:

@@ -1,0 +1,399 @@
+"""Host-RAM KV spill tier behind the prefix cache (ISSUE 4).
+
+Evicting a prefix page used to throw its computed KV away; with a
+HostPageStore configured the page spills device->host on eviction and a
+later hash-chain hit restores it with a device_put + scatter instead of a
+prefill forward pass. The engine-level tests prove the acceptance
+contract: fill the cache -> force eviction -> resubmit -> the prefix rows
+come back from the host tier (prefix_rows_restored, zero recompute for
+the restored region) and the decoded output is token-identical to the
+recompute path. The store/allocator units run in the fast tier (pure
+Python, no jit).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from aios_tpu.engine.paged import (
+    HOST_OVERLAP_DISCOUNT,
+    HostPageStore,
+    PageAllocator,
+    PoolExhausted,
+    PrefixIndex,
+)
+
+
+# ---------------------------------------------------------------------------
+# HostPageStore units (fast tier)
+# ---------------------------------------------------------------------------
+
+
+def _entry(n_bytes=1024):
+    return {"k": np.zeros(n_bytes // 2, np.int8),
+            "v": np.zeros(n_bytes // 2, np.int8)}
+
+
+def test_store_budget_evicts_lru():
+    s = HostPageStore(max_bytes=3 * 1024)
+    for h in (b"a", b"b", b"c"):
+        s.put(h, _entry())
+    assert s.bytes_resident == 3 * 1024
+    s.match_chain([b"a"])  # refresh a: b becomes the LRU victim
+    s.put(b"d", _entry())
+    assert s.peek_chain([b"b"]) == 0  # evicted
+    assert s.peek_chain([b"a"]) == 1
+    assert s.bytes_resident == 3 * 1024
+    assert s.spills == 4
+
+
+def test_store_oversized_entry_rejected():
+    s = HostPageStore(max_bytes=512)
+    s.put(b"big", _entry(1024))
+    assert len(s) == 0 and s.bytes_resident == 0
+
+
+def test_store_match_chain_is_longest_prefix():
+    s = HostPageStore(max_bytes=1 << 20)
+    for h in (b"1", b"2", b"4"):
+        s.put(h, _entry())
+    got = s.match_chain([b"1", b"2", b"3", b"4"])
+    assert [h for h, _ in got] == [b"1", b"2"]  # stops at the first miss
+    assert s.hits == 1
+    got = s.match_chain([b"9"])
+    assert got == [] and s.misses == 1
+
+
+def test_store_peek_does_not_touch_lru_or_counters():
+    s = HostPageStore(max_bytes=2 * 1024)
+    s.put(b"a", _entry())
+    s.put(b"b", _entry())
+    for _ in range(5):
+        assert s.peek_chain([b"a", b"b"]) == 2
+    assert s.hits == 0 and s.misses == 0
+    # a was NOT refreshed by the peeks: it is still the LRU victim
+    s.put(b"c", _entry())
+    assert s.peek_chain([b"a"]) == 0
+
+
+def test_store_discard_counts_restores():
+    s = HostPageStore(max_bytes=1 << 20)
+    s.put(b"a", _entry())
+    s.put(b"b", _entry())
+    s.discard([b"a"], restored=True)
+    s.discard([b"b"])  # plain invalidation
+    s.discard([b"missing"], restored=True)  # no-op
+    assert s.restores == 1
+    assert len(s) == 0 and s.bytes_resident == 0
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator: refcount accessor + restore-path allocation (fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcount_accessor():
+    a = PageAllocator(num_pages=5, page_size=16, num_slots=2, max_blocks=4)
+    a.ensure(0, 16)
+    page = int(a.tables[0, 0])
+    assert a.refcount(page) == 1
+    a.incref(page)
+    assert a.refcount(page) == 2
+    a.decref(page)
+    a.free_slot(0)
+    assert a.refcount(page) == 0  # back on the free list
+
+
+def test_alloc_pages_and_append_owned():
+    a = PageAllocator(num_pages=9, page_size=16, num_slots=2, max_blocks=8)
+    shared = a.alloc_pages(1)
+    a.map_shared(0, shared)  # rc 2: alloc_pages + map_shared
+    fresh = a.alloc_pages(2)
+    assert len(set(fresh) | set(shared)) == 3
+    a.append_owned(0, fresh)
+    assert a.slot_rows_backed(0) == 3 * 16
+    assert [int(p) for p in a.tables[0, :3]] == shared + fresh
+    for p in fresh:
+        assert a.refcount(p) == 1
+    with pytest.raises(PoolExhausted):
+        a.alloc_pages(100)
+    assert a.free_pages == 8 - 3  # failed alloc left nothing allocated
+    a.free_slot(0)
+    a.decref(shared[0])  # the alloc_pages reference
+    assert a.free_pages == 8
+
+
+def test_reclaim_uses_public_refcount_and_spills(monkeypatch):
+    """PrefixIndex.reclaim goes through allocator.refcount() and hands
+    evicted entries to the spill hook BEFORE their references drop."""
+    a = PageAllocator(num_pages=6, page_size=16, num_slots=2, max_blocks=4)
+    ix = PrefixIndex(a, max_pages=10)
+    a.ensure(0, 3 * 16)
+    pages = [int(p) for p in a.tables[0, :3]]
+    ix.put([b"h1", b"h2", b"h3"], pages)
+    a.free_slot(0)  # index now sole owner (rc 1 each)
+    seen = []
+
+    def spill(evicted):
+        for h, p in evicted:
+            assert a.refcount(p) == 1, "spill must run before the decref"
+            seen.append((h, p))
+
+    ix.spill = spill
+    freed = ix.reclaim(2)
+    assert freed == 2
+    assert [h for h, _ in seen] == [b"h1", b"h2"]  # coldest first
+    for _, p in seen:
+        assert a.refcount(p) == 0  # freed after the capture
+
+
+def test_spill_hook_failure_degrades_to_plain_eviction():
+    a = PageAllocator(num_pages=4, page_size=16, num_slots=1, max_blocks=3)
+    ix = PrefixIndex(a, max_pages=10)
+    a.ensure(0, 2 * 16)
+    pages = [int(p) for p in a.tables[0, :2]]
+    ix.put([b"x", b"y"], pages)
+    a.free_slot(0)
+
+    def bad_spill(evicted):
+        raise RuntimeError("host store broke")
+
+    ix.spill = bad_spill
+    assert ix.reclaim(2) == 2  # pages still freed, no exception
+    assert a.free_pages == 3
+
+
+# ---------------------------------------------------------------------------
+# engine integration (slow tier, pattern of tests/test_paged.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model
+    from aios_tpu.engine.config import TINY_TEST
+
+    return model.init_params(TINY_TEST, jax.random.PRNGKey(1),
+                             dtype=jnp.float32)
+
+
+def make_engine(params, host_bytes=64 << 20, **kw):
+    import jax.numpy as jnp
+
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_context", 256)
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("paged_pool_rows", 256)
+    kw.setdefault("page_size", 32)
+    return TPUEngine(TINY_TEST, params, prefix_host_bytes=host_bytes, **kw)
+
+
+def _force_spill(eng, rng, min_entries=2, blocks=6):
+    """Register a big disjoint prompt so the allocator's reclaim evicts
+    (and spills) the coldest index entries, then wait for the spill
+    worker to drain."""
+    pressure = [int(t) for t in rng.integers(1, 500, blocks * 32 + 8)]
+    eng.prefill(0, pressure, temperature=0.0)
+    eng.release(0)
+    deadline = time.time() + 20
+    # wait for a FULL drain (_spill_pending == 0), not just min_entries:
+    # a worker still landing the tail of a batch between a test's two
+    # snapshots would skew counts taken at different times
+    while (len(eng.host_store) < min_entries or eng._spill_pending) \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(eng.host_store) >= min_entries, "spill worker never drained"
+    assert eng._spill_pending == 0, "spill backlog never drained"
+    return pressure
+
+
+@pytest.mark.slow
+def test_spill_restore_token_identical(params):
+    """THE acceptance path: fill prefix cache -> force eviction (spill)
+    -> resubmit the same prompt -> rows restore from the host tier with
+    zero prefill recompute for the restored region, and the decoded
+    output is token-identical to the recompute path."""
+    rng = np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]  # 3 full blocks
+
+    ref_eng = make_engine(params, host_bytes=0)  # recompute path
+    ref = ref_eng.generate(prompt, max_new_tokens=24, temperature=0.0)
+    ref_eng.close()
+
+    eng = make_engine(params)
+    assert eng.host_store is not None
+    cold = eng.generate(prompt, max_new_tokens=24, temperature=0.0)
+    assert cold == ref
+    assert eng.prefix_rows_restored == 0
+    _force_spill(eng, rng)
+    reused_before = eng.prefix_rows_reused
+    again = eng.generate(prompt, max_new_tokens=24, temperature=0.0)
+    assert again == ref  # token-identical to the recompute path
+    # the spilled region came back via the host tier, not prefill and
+    # not the HBM index (its entries were evicted by the reclaim)
+    assert eng.prefix_rows_restored >= 2 * 32
+    assert eng.prefix_rows_reused == reused_before
+    assert eng.host_store.restores >= 2
+    stats = eng.stats()
+    assert stats["prefix_rows_restored"] == eng.prefix_rows_restored
+    assert stats["host_tier_restores"] >= 2
+    eng.close()
+
+
+@pytest.mark.slow
+def test_restored_pages_reregister_in_hbm_index(params):
+    """After a restore the hashes are back in the HBM index: a THIRD
+    submission maps them as plain prefix pages (rows_reused moves,
+    rows_restored does not)."""
+    rng = np.random.default_rng(8)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]
+    eng = make_engine(params)
+    ref = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    _force_spill(eng, rng)
+    assert eng.generate(prompt, max_new_tokens=16, temperature=0.0) == ref
+    restored = eng.prefix_rows_restored
+    assert restored > 0
+    third = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    assert third == ref
+    assert eng.prefix_rows_restored == restored  # no second restore
+    assert eng.prefix_rows_reused >= restored  # HBM hit this time
+    eng.close()
+
+
+@pytest.mark.slow
+def test_reclaim_spill_restore_interleaving_invariants(params):
+    """Allocator-pressure reclaim + restore interleaving: pool exhaustion
+    triggers reclaim(), evicted pages spill to host, a later request
+    restores them, and refcounts/free-list stay consistent — no page is
+    simultaneously free-listed and mapped."""
+    rng = np.random.default_rng(9)
+    eng = make_engine(params)
+    prompts = [
+        [int(t) for t in rng.integers(1, 500, 70 + 10 * i)] for i in range(4)
+    ]
+    for _ in range(3):  # several pressure/restore rounds
+        for p in prompts:
+            eng.prefill(0, p, temperature=0.0)
+            eng.step(2)
+            eng.release(0)
+    deadline = time.time() + 20
+    while eng._spill_pending and time.time() < deadline:
+        time.sleep(0.02)
+    alloc = eng.allocator
+    free = set(alloc._free[0])
+    indexed = set(eng.prefix_index._index.values())
+    mapped = set()
+    for s in range(eng.num_slots):
+        used = int(alloc._blocks_used[s])
+        mapped.update(int(p) for p in alloc.tables[s, :used])
+    # a free-listed page must not be mapped anywhere nor indexed
+    assert not (free & indexed), (free, indexed)
+    assert not (free & mapped), (free, mapped)
+    for p in free:
+        assert alloc.refcount(p) == 0
+    for p in indexed:
+        assert alloc.refcount(p) >= 1
+    # accounting balances: every usable page is free or referenced
+    usable = alloc.num_pages - alloc.replicas
+    held = [p for p in range(1, alloc.local_pages) if alloc.refcount(p) > 0]
+    assert len(free) + len(held) == usable
+    assert eng.host_store.spills > 0 and eng.host_store.restores > 0
+    eng.close()
+
+
+@pytest.mark.slow
+def test_restore_min_pages_floor(params):
+    """A host chain shorter than the floor is skipped: the prompt
+    prefills normally (still token-identical), nothing restores."""
+    rng = np.random.default_rng(10)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]  # 3 full blocks
+    eng = make_engine(params, host_restore_min_pages=8)
+    ref = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    _force_spill(eng, rng)
+    assert eng.generate(prompt, max_new_tokens=16, temperature=0.0) == ref
+    assert eng.prefix_rows_restored == 0  # floor kept the tier out
+    assert eng.host_store.restores == 0
+    eng.close()
+
+
+@pytest.mark.slow
+def test_overlap_rows_credit_host_tier_at_discount(params):
+    """The router's overlap probe scores host-resident rows at
+    HOST_OVERLAP_DISCOUNT — lower than HBM residency, higher than
+    nothing — without touching store LRU/counters."""
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]  # 3 full blocks
+    eng = make_engine(params)
+    eng.prefill(0, prompt, temperature=0.0)
+    eng.release(0)
+    assert eng.prefix_overlap_rows(prompt) == 96  # all HBM
+    _force_spill(eng, rng, min_entries=2)
+    hits, misses = eng.host_store.hits, eng.host_store.misses
+    rows = eng.prefix_overlap_rows(prompt)
+    n_host = eng.host_store.peek_chain(eng.prefix_hashes(prompt))
+    assert n_host >= 2
+    assert rows == int(n_host * 32 * HOST_OVERLAP_DISCOUNT)
+    assert 0 < rows < 96
+    # read-only probe: no hit/miss movement
+    assert (eng.host_store.hits, eng.host_store.misses) == (hits, misses)
+    eng.close()
+
+
+@pytest.mark.slow
+def test_warmup_leaves_host_store_empty(params):
+    eng = make_engine(params, paged_pool_rows=1024)
+    eng.warmup(step_sizes=(1,))
+    assert len(eng.host_store) == 0
+    assert len(eng.prefix_index._index) == 0
+    # the tier still works after warmup
+    rng = np.random.default_rng(12)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]
+    ref = eng.generate(prompt, max_new_tokens=8, temperature=0.0)
+    assert eng.generate(prompt, max_new_tokens=8, temperature=0.0) == ref
+    eng.close()
+
+
+@pytest.mark.slow
+def test_host_tier_disabled_without_budget(params):
+    """No budget -> no store, no spill thread; eviction behaves exactly
+    as before the tier existed."""
+    eng = make_engine(params, host_bytes=0)
+    assert eng.host_store is None and eng._spill_thread is None
+    assert eng.prefix_index.spill is None
+    rng = np.random.default_rng(13)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]
+    ref = eng.generate(prompt, max_new_tokens=8, temperature=0.0)
+    big = [int(t) for t in rng.integers(1, 500, 200)]
+    eng.prefill(0, big, temperature=0.0)
+    eng.release(0)
+    assert eng.generate(prompt, max_new_tokens=8, temperature=0.0) == ref
+    assert eng.prefix_rows_restored == 0
+    eng.close()
+
+
+@pytest.mark.slow
+def test_int8_pool_spill_restore(params):
+    """The int8 page pool spills and restores its scales alongside the
+    quantized KV — output identical to the int8 recompute path."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(14)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]
+    ref_eng = make_engine(params, host_bytes=0, cache_dtype=jnp.int8)
+    ref = ref_eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    ref_eng.close()
+    eng = make_engine(params, cache_dtype=jnp.int8)
+    assert eng.generate(prompt, max_new_tokens=16, temperature=0.0) == ref
+    _force_spill(eng, rng)
+    entry = next(iter(eng.host_store._entries.values()))
+    assert set(entry) == {"k", "v", "k_s", "v_s"}
+    assert eng.generate(prompt, max_new_tokens=16, temperature=0.0) == ref
+    assert eng.prefix_rows_restored > 0
+    eng.close()

@@ -103,6 +103,41 @@ def test_serving_family_complete_and_typed():
     assert serving == SERVING_EXPECTED
 
 
+# -- the prefix-cache host tier family (engine/paged.py HostPageStore) -----
+
+PREFIX_HOST_EXPECTED = {
+    "aios_tpu_prefix_host_resident_bytes": "gauge",
+    "aios_tpu_prefix_host_spills_total": "gauge",
+    "aios_tpu_prefix_host_restores_total": "gauge",
+    "aios_tpu_prefix_host_hits_total": "gauge",
+    "aios_tpu_prefix_host_misses_total": "gauge",
+    "aios_tpu_prefix_host_restore_seconds": "histogram",
+}
+
+
+def test_prefix_host_family_complete_and_typed():
+    """The host spill tier instruments the ISSUE 4 catalog promises
+    exist, with the promised kinds — and any NEW aios_tpu_prefix_host_*
+    metric must be added here (and to docs/OBSERVABILITY.md) so the
+    family stays reviewed."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_prefix_host_")
+    }
+    assert family == PREFIX_HOST_EXPECTED
+
+
+def test_prefix_host_labels_are_model_only():
+    """Host-tier series stay one-per-model: the store is per engine
+    (replica stats sum through pool.stats()), so nothing here may grow a
+    per-hash or per-replica label."""
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_prefix_host_"):
+            assert tuple(m.labelnames) == ("model",), (
+                f"{m.name}: host-tier metrics carry exactly the model label"
+            )
+
+
 def test_serving_label_conventions():
     """Serving labels stay low-cardinality by construction: routing
     reasons and shed causes are fixed enums (see serving/pool.py); only
