@@ -57,6 +57,12 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             "prefix_host_bytes": "",
             "host_restore_min_pages": "",
             "speculative": False,    # n-gram speculative decode
+            # pipelined decode loop: dispatch N+1 enqueues while dispatch
+            # N's tokens are emitted/detokenized (docs/ENGINE_PERF.md);
+            # unified_step folds every decode chunk size into ONE
+            # dynamic-n XLA graph (greedy-identical; opt-in). "" = off.
+            "decode_pipeline": "",
+            "unified_step": "",
             "json_mode": "",         # "force" = reference json_object parity
             "guided_toolcalls": False,  # schema-guided reasoning replies
             # multi-chip serving mesh, e.g. "tp=4" (BASELINE config 4:
@@ -203,6 +209,18 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         put("AIOS_TPU_MESH", str(m["mesh"]))
     if m.get("speculative"):
         put("AIOS_TPU_SPECULATIVE", "1")
+    # tri-state decode-loop knobs: "" = unset (config/engine defaults
+    # apply); an explicit false forwards too, so config can turn OFF a
+    # ModelConfig.decode_pipeline/unified_step default
+    for cfg_key, env_key in (
+        ("decode_pipeline", "AIOS_TPU_DECODE_PIPELINE"),
+        ("unified_step", "AIOS_TPU_UNIFIED_STEP"),
+    ):
+        raw = m.get(cfg_key, "")
+        if raw in ("", None):
+            continue
+        truthy = str(raw).strip().lower() in ("1", "true", "on", "yes")
+        put(env_key, "1" if truthy else "0")
     if m.get("json_mode"):
         put("AIOS_TPU_JSON_MODE", str(m["json_mode"]))
     if m.get("guided_toolcalls"):

@@ -26,13 +26,21 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .engine import ChunkedPrefill, TPUEngine
+from .engine import ChunkedPrefill, PendingDecode, TPUEngine, _env_flag
 from .paged import PoolExhausted
 from ..obs import instruments as obs
 
 log = logging.getLogger("aios.batcher")
 
 _END = object()
+
+# Live batchers per model name: replica batchers share the (model,) label
+# on the aios_tpu_engine_dispatch_inflight_total gauge, and set_function
+# is last-writer-wins — so the scrape callback sums over this set instead
+# of reporting whichever replica registered last (the same aggregation
+# pattern as engine._HOST_STORES_BY_MODEL). Dead batchers drop out when
+# collected; a shut-down batcher reports 0 (its pending is dropped).
+_BATCHERS_BY_MODEL: Dict[str, object] = {}
 
 # Queued requests gain +1 effective priority per this many seconds
 # waiting, bounding starvation under sustained higher-priority traffic
@@ -80,6 +88,17 @@ class _Live:
     # the truncated output as a normal completion
     abort_reason: str = ""
     constraint: object = None  # jsonmode.JsonConstraint when json_mode
+
+
+@dataclass
+class _PendingTick:
+    """One pipelined decode dispatch in flight: the engine's pending
+    handle plus the live map snapshotted AT DISPATCH TIME — its tokens
+    belong to the requests that were live then (requests retired since
+    have ``done`` set and their columns are dropped at consume)."""
+
+    pending: PendingDecode
+    lives: Dict[int, "_Live"]
 
 
 class RequestHandle:
@@ -141,8 +160,37 @@ class ContinuousBatcher:
         spec_draft_len: int = 7,
         spec_ngram: int = 3,
         tokenizer=None,  # enables json_mode requests (mask table source)
+        pipeline: Optional[bool] = None,  # depth-2 pipelined decode loop
     ) -> None:
         self.engine = engine
+        # Pipelined decode (AIOS_TPU_DECODE_PIPELINE /
+        # ModelConfig.decode_pipeline): dispatch N+1 is enqueued BEFORE
+        # dispatch N's tokens are consumed, so the host's emit/detokenize/
+        # retire phase overlaps device execution instead of idling it — a
+        # depth-2 double buffer over the plain decode path, with explicit
+        # flushes at grammar-constrained ticks, pool-pressure evictions,
+        # and idle boundaries (_flush_pending). Greedy token streams are
+        # identical to the unpipelined loop (per-dispatch length
+        # snapshots anchor out-of-cache retirement to the dispatch that
+        # produced each token); sampled streams are identical for
+        # batches admitted together (<= slots) — under queue pressure a
+        # freed slot re-admits one dispatch later than the sync loop
+        # would, shifting the shared key-split sequence.
+        if pipeline is None:
+            pipeline = _env_flag("AIOS_TPU_DECODE_PIPELINE")
+        if pipeline is None:
+            pipeline = bool(getattr(engine.cfg, "decode_pipeline", False))
+        self.pipeline = bool(pipeline)
+        self._pending: Optional[_PendingTick] = None
+        self.flushes = 0
+        # host-gap accounting: wall time between consecutive decode
+        # dispatches spent on the host (the device-idle window the
+        # pipeline exists to close); bench_dispatch reads the totals
+        self.decode_dispatches = 0
+        self.host_gap_seconds = 0.0
+        self._gap_mark: Optional[float] = None
+        self._gap_wait = 0.0  # time blocked in consume-wait since the mark
+        self._mask_base = None  # cached all-zeros [slots, vocab] device mask
         self.tokenizer = tokenizer
         self._json_masks = None  # lazy jsonmode.JsonMaskCache
         self._json_masks_lock = threading.Lock()
@@ -209,22 +257,20 @@ class ContinuousBatcher:
         # sizes are compiled too (warmup's defaults cover the default sizes;
         # a non-default chunk_steps would otherwise compile for seconds on
         # the scheduler thread at first dispatch, stalling live requests).
-        # A never-warmed engine (tests, lazy callers) is left lazy.
+        # AOT — compile_step_fn lowers without dispatching, so attaching a
+        # batcher never perturbs engine state. Largest size first keeps
+        # unified_step engines on ONE dynamic-n graph. A never-warmed
+        # engine (tests, lazy callers) is left lazy.
         if engine._step_fns:
-            for n in {self.admit_chunk_steps, self.chunk_steps} - set(
-                engine._step_fns
+            for n in sorted(
+                {self.admit_chunk_steps, self.chunk_steps}, reverse=True
             ):
-                engine.step(n)
+                engine.compile_step_fn(n)
             if self.speculative:
                 for n in {self.admit_chunk_steps, self.chunk_steps}:
-                    if (n, self.spec_draft_len, self.spec_ngram) not in (
-                        engine._spec_fns
-                    ):
-                        engine.spec_step(
-                            n,
-                            draft_len=self.spec_draft_len,
-                            ngram=self.spec_ngram,
-                        )
+                    engine.compile_spec_fn(
+                        n, self.spec_draft_len, self.spec_ngram
+                    )
         # Metric children resolved ONCE (labels() is a locked dict lookup
         # — fine per request, too slow per decoded token); the queue-depth
         # gauge pulls live state at scrape time through a weakref so a
@@ -244,10 +290,16 @@ class ContinuousBatcher:
             model=model_name
         )
         self._obs_tps = obs.ENGINE_TOKENS_PER_SECOND.labels(model=model_name)
+        self._obs_gap = obs.ENGINE_DISPATCH_HOST_GAP.labels(model=model_name)
         _ref = weakref.ref(self)
         obs.ENGINE_QUEUE_DEPTH.labels(model=model_name).set_function(
             lambda: (lambda b: float(b.queue_depth()) if b is not None
                      else 0.0)(_ref())
+        )
+        peers = _BATCHERS_BY_MODEL.setdefault(model_name, weakref.WeakSet())
+        peers.add(self)
+        obs.ENGINE_DISPATCH_INFLIGHT.labels(model=model_name).set_function(
+            lambda: float(sum(1 for b in peers if b._pending is not None))
         )
         # tokens/sec gauge state: emitted tokens over a ~1 s window,
         # refreshed from the scheduler loop (decays to 0 when idle).
@@ -640,7 +692,8 @@ class ContinuousBatcher:
         live.constraint.advance(forced)
         return forced
 
-    def _emit(self, live: _Live, token: int) -> None:
+    def _emit(self, live: _Live, token: int,
+              slot_len: Optional[int] = None) -> None:
         if live.cancelled:
             return  # reaped (slot freed) at the next tick boundary
         live.produced += 1
@@ -649,11 +702,88 @@ class ContinuousBatcher:
         live.out_q.put(token)
         hit_stop = token in live.req.stop_ids
         out_of_budget = live.produced >= live.req.max_tokens
-        out_of_cache = (
-            self.engine.slot_length(live.slot) >= self.engine.max_context - 1
-        )
+        # pipelined consumes pass the slot length AS OF the dispatch that
+        # produced this token — the engine's live length already includes
+        # the in-flight next dispatch, and reading it would retire
+        # requests one dispatch early (diverging from the sync loop)
+        if slot_len is None:
+            slot_len = self.engine.slot_length(live.slot)
+        out_of_cache = slot_len >= self.engine.max_context - 1
         if hit_stop or out_of_budget or out_of_cache:
             self._finish(live)
+
+    # -- pipelined decode (depth-2 double buffer) ---------------------------
+
+    def _consume(self, tick: _PendingTick) -> None:
+        """Emit one finished dispatch's tokens to whoever is still live.
+
+        A PoolExhausted surfacing from the dispatch worker (the ensure()
+        failed; engine state untouched) retires a victim here instead —
+        the batch retries on a later dispatch, exactly like the sync
+        loop's dispatch-site handling."""
+        t0 = time.monotonic()
+        try:
+            tokens = tick.pending.wait()
+        except PoolExhausted as e:
+            self._gap_wait += time.monotonic() - t0
+            # the depth-2 buffer already issued the NEXT dispatch against
+            # the same exhausted pool — collect its (identical) failure
+            # BEFORE evicting, or the eviction path would flush it, see a
+            # second PoolExhausted, and retire a second victim for ONE
+            # pressure event
+            nxt, self._pending = self._pending, None
+            if nxt is not None:
+                try:
+                    nxt.pending.wait()
+                except PoolExhausted:
+                    pass  # state untouched; the post-evict tick retries
+                else:
+                    self._pending = nxt  # it ran after all: deliver it
+            self._evict_longest(e.replica)
+            return
+        self._gap_wait += time.monotonic() - t0
+        lengths = tick.pending.lengths
+        for row in tokens:
+            for slot, live in tick.lives.items():
+                if live.done:
+                    continue
+                self._emit(live, int(row[slot]), slot_len=int(lengths[slot]))
+
+    def _flush_pending(self, cause: str) -> None:
+        """Consume the in-flight pipelined dispatch NOW. Called whenever
+        the next dispatch cannot be issued ahead of consumption:
+        grammar-constrained ticks (the mask depends on every emitted
+        token), speculative ticks, pool-pressure evictions (a victim's
+        already-produced tokens must land before its stream aborts), and
+        idle boundaries. No-op when nothing is pending."""
+        tick = self._pending
+        if tick is None:
+            return
+        self._pending = None
+        self.flushes += 1
+        # labels() resolves per FLUSH, not per token — the locked lookup
+        # is fine at this rate (unlike the per-token children above)
+        obs.ENGINE_DISPATCH_FLUSHES.labels(
+            model=self.engine.cfg.name, cause=cause
+        ).inc()
+        self._consume(tick)
+
+    def _note_dispatch(self) -> None:
+        """Record the host gap since the previous decode dispatch (the
+        window the device idles in the sync loop; the pipeline's whole
+        point is to hide it). Call immediately BEFORE dispatching; the
+        dispatch site stamps ``_gap_mark`` when the engine call returns.
+        Time the pipelined tick spent BLOCKED waiting on the previous
+        dispatch's tokens (``_gap_wait``) is subtracted — that's device
+        time, and counting it would make the pipelined gap read as if
+        the host were busier than the sync loop's."""
+        if self._gap_mark is not None:
+            gap = time.monotonic() - self._gap_mark - self._gap_wait
+            gap = max(gap, 0.0)
+            self.host_gap_seconds += gap
+            self.decode_dispatches += 1
+            self._obs_gap.observe(gap)
+        self._gap_wait = 0.0
 
     def _finish(self, live: _Live, *, was_cancelled: bool = False,
                 abort_reason: str = "") -> None:
@@ -718,6 +848,10 @@ class ContinuousBatcher:
 
         Returns "evicted", "empty" (nothing live to evict), or "blocked"
         (only higher-priority victims exist)."""
+        # land the in-flight pipelined tokens first: the victim keeps what
+        # it already produced (matching the sync loop), and a retirement
+        # during the flush may itself free the pages this hunt is after
+        self._flush_pending("evict")
         alloc = self.engine.allocator
         with self._lock:
             candidates = [
@@ -767,6 +901,10 @@ class ContinuousBatcher:
         completion). Called on scheduler failure and on shutdown — any
         path after which no scheduler pass will run again."""
         victims: List[_Live] = []
+        # an in-flight pipelined dispatch dies with the scheduler: its
+        # tokens would extend streams that are being aborted as
+        # truncations anyway, so drop, don't emit
+        self._pending = None
         if self._prefilling is not None:
             victims.append(self._prefilling[0])
             self._prefilling = None
@@ -805,12 +943,24 @@ class ContinuousBatcher:
                 self.last_tps = rate
             self._rate_tokens = 0
             self._rate_t0 = now
+        if self._pending is not None:
+            # ordering fence: the pipelined dispatch handed to the worker
+            # last tick must HOLD the engine lock before this tick issues
+            # any engine call (slot releases, admissions, chunk writes) —
+            # those must land after it, or the slot set it was issued
+            # against could change under it
+            self._pending.pending.wait_started()
         self._reap_cancelled()
         self._advance_prefill()
         self._admit()
         with self._lock:
             slots = {s: l for s, l in self._live.items()}
         if not slots:
+            # nothing live NOW: land whatever the last pipelined dispatch
+            # produced (its requests retired mid-consume, so this usually
+            # just drops garbage columns) before going idle
+            self._flush_pending("idle")
+            self._gap_mark = None
             if self._prefilling is not None:
                 return  # nothing to decode; keep chunking
             self._wake.wait(timeout=0.05)
@@ -821,28 +971,40 @@ class ContinuousBatcher:
         ]
         if constrained:
             # grammar masks change per emitted token, so constrained slots
-            # ride 1-step dispatches; unconstrained co-residents decode
-            # unmasked (zero rows) in the same batch. Rows are cached
-            # DEVICE-resident per automaton state, so the [S, V] mask
-            # assembles on device — no per-step PCIe traffic.
+            # ride 1-step dispatches — and the mask for the NEXT step
+            # depends on every token emitted so far, so the pipeline
+            # drains first. Rows are cached DEVICE-resident per automaton
+            # state and scattered into a cached all-zeros [S, V] base, so
+            # unconstrained co-resident slots cost nothing (no per-slot
+            # row stack, no per-step PCIe traffic).
+            self._flush_pending("constrained")
             import jax.numpy as jnp
 
             by_slot = dict(constrained)
-            zeros = constrained[0][1].constraint.cache.zeros_row()
+            idx = sorted(by_slot)
             rows = [
-                (
-                    by_slot[s_].constraint.device_mask(
-                        remaining=by_slot[s_].req.max_tokens
-                        - by_slot[s_].produced
-                    )
-                    if s_ in by_slot
-                    else zeros
+                by_slot[s_].constraint.device_mask(
+                    remaining=by_slot[s_].req.max_tokens
+                    - by_slot[s_].produced
                 )
-                for s_ in range(self.engine.num_slots)
+                for s_ in idx
             ]
-            mask = jnp.stack(rows)
+            if len(idx) == self.engine.num_slots:
+                mask = jnp.stack(rows)
+            else:
+                base = self._mask_base
+                if base is None:
+                    base = self._mask_base = jnp.zeros(
+                        (self.engine.num_slots, self.engine.cfg.vocab_size),
+                        jnp.float32,
+                    )
+                mask = base.at[jnp.asarray(idx, jnp.int32)].set(
+                    jnp.stack(rows)
+                )
             try:
+                self._note_dispatch()
                 tokens = self.engine.step_masked(mask)
+                self._gap_mark = time.monotonic()
             except PoolExhausted as e:
                 self._evict_longest(e.replica)
                 return
@@ -866,11 +1028,17 @@ class ContinuousBatcher:
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
         if self.speculative:
             # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
-            # run in order; _emit retires requests mid-dispatch as usual
+            # run in order; _emit retires requests mid-dispatch as usual.
+            # Speculative dispatches consume their own output synchronously
+            # (acceptance counts gate the emit), so they never pipeline;
+            # drain any pending plain dispatch first.
+            self._flush_pending("spec")
             try:
+                self._note_dispatch()
                 tokens, counts = self.engine.spec_step(
                     n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
                 )
+                self._gap_mark = time.monotonic()
             except PoolExhausted as e:
                 self._evict_longest(e.replica)  # retry next tick
                 return
@@ -883,8 +1051,29 @@ class ContinuousBatcher:
                         if live.done:
                             break
             return
+        if self.pipeline:
+            # depth-2 double buffer: hand dispatch N+1 to the engine's
+            # dispatch worker, THEN consume dispatch N — the host's
+            # emit/retire phase runs while N+1 executes (the worker holds
+            # the blocking graph call + readback, so this overlaps even
+            # on the CPU backend, where XLA executes inline in the
+            # dispatching thread). Tokens stream identically to the sync
+            # loop: each dispatch's live map and post-dispatch lengths
+            # are snapshotted, so late retirements drop exactly the
+            # columns the sync loop would never have dispatched. A
+            # PoolExhausted surfaces at consume time (_consume evicts).
+            prev = self._pending
+            self._note_dispatch()
+            handle = self.engine.step_async(n)
+            self._gap_mark = time.monotonic()
+            self._pending = _PendingTick(handle, slots)
+            if prev is not None:
+                self._consume(prev)
+            return
         try:
+            self._note_dispatch()
             tokens = self.engine.step(n)  # [n, num_slots]
+            self._gap_mark = time.monotonic()
         except PoolExhausted as e:
             # retire the longest request and retry on the next tick; the
             # failed ensure() left all engine state untouched

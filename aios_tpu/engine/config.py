@@ -45,6 +45,16 @@ class ModelConfig:
     # device-side on a later hash-chain hit instead of re-prefilled.
     # 0 = off; AIOS_TPU_PREFIX_HOST_BYTES overrides at load time.
     prefix_host_bytes: int = 0
+    # pipelined decode loop (engine/batching.py): decode dispatch N+1 is
+    # enqueued before dispatch N's tokens are emitted/detokenized, so the
+    # host phase overlaps device execution instead of idling it.
+    # AIOS_TPU_DECODE_PIPELINE overrides at load time (docs/ENGINE_PERF.md).
+    decode_pipeline: bool = False
+    # unified dynamic-step decode graph (engine/engine.py _unified_impl):
+    # one compiled fori_loop serves every decode chunk size instead of one
+    # scan graph per size. Greedy-identical; sampled sequences draw from a
+    # different key fanout. AIOS_TPU_UNIFIED_STEP overrides at load time.
+    unified_step: bool = False
 
     @property
     def moe(self) -> bool:

@@ -187,9 +187,61 @@ def _on_accelerator(params) -> bool:
                 continue
     return False
 
+
+def _env_flag(name: str) -> Optional[bool]:
+    """Tri-state env boolean: None when unset/blank (caller falls back to
+    its config default), else the lenient truthiness the other AIOS_TPU_*
+    knobs use."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return None
+    return raw in ("1", "true", "on", "yes")
+
+
 # Device-resident decode state, threaded through the jitted cores as one
 # donated pytree: {k, v, lengths, last_tokens, temps, top_ps, key}
 DecodeState = Dict[str, jnp.ndarray]
+
+
+class PendingDecode:
+    """Handle for a decode dispatch running on the engine's dispatch
+    worker (engine.step_async).
+
+    The worker thread performs the whole dispatch — lock, graph call,
+    device->host token readback — so the CALLER's thread overlaps its own
+    host work (emit/detokenize/retire) with the device execution; on the
+    CPU backend, where XLA executes "parallel" computations inline in the
+    dispatching call, the worker is the ONLY way to get that overlap (the
+    GIL is released inside the XLA call).
+
+    ``wait()`` blocks until the tokens materialize and returns the host
+    ``[n_steps, S]`` array. ``lengths`` (valid after ``wait()``)
+    snapshots the host slot lengths AFTER this dispatch's advance — the
+    batcher's out-of-cache retirement check must read the lengths as of
+    THIS dispatch, not whatever later dispatches have since added
+    (pipeline-on output would otherwise retire early and diverge from
+    pipeline-off). ``wait_started()`` blocks until the dispatch holds the
+    engine lock: ordering fence for callers about to issue further
+    engine calls that must land AFTER this dispatch."""
+
+    __slots__ = ("_fut", "_started", "n_steps", "tokens", "lengths")
+
+    def __init__(self, fut, n_steps: int, started: threading.Event) -> None:
+        self._fut = fut
+        self._started = started
+        self.n_steps = int(n_steps)
+        self.tokens: Optional[np.ndarray] = None
+        self.lengths: Optional[np.ndarray] = None
+
+    def wait_started(self) -> None:
+        if self.tokens is not None or self._fut.done():
+            return  # finished implies started; skip the event syscall
+        self._started.wait()
+
+    def wait(self) -> np.ndarray:
+        if self.tokens is None:
+            self.tokens, self.lengths = self._fut.result()
+        return self.tokens
 
 
 class TPUEngine:
@@ -214,6 +266,7 @@ class TPUEngine:
         host_restore_min_pages: Optional[int] = None,  # restore floor
         seq_sharded_cache: bool = False,  # shard KV context axis over sp
         track_history: bool = True,  # device-side token history (spec.py)
+        unified_step: Optional[bool] = None,  # one dynamic-n decode graph
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -577,6 +630,24 @@ class TPUEngine:
         self._chunk_fns: Dict[Tuple[int, bool], object] = {}
         self._spec_fns: Dict[Tuple[int, int, int], object] = {}
         self._restore_fns: Dict[int, object] = {}
+        # Unified decode graph: ONE compiled fori_loop over a static
+        # max-steps bound with the actual step count as a DYNAMIC operand,
+        # so every chunk size the batcher dispatches shares a single XLA
+        # graph instead of compiling per size (warmup compiles 1 graph,
+        # not len(step_sizes)). Greedy output is identical to the per-size
+        # scan graphs; sampling draws from a different (fixed-fanout) key
+        # split, so the knob is opt-in (AIOS_TPU_UNIFIED_STEP /
+        # ModelConfig.unified_step) rather than the default.
+        if unified_step is None:
+            unified_step = _env_flag("AIOS_TPU_UNIFIED_STEP")
+        if unified_step is None:
+            unified_step = bool(getattr(cfg, "unified_step", False))
+        self.unified_step = bool(unified_step)
+        self._unified_max = 0
+        # single-thread dispatch worker behind step_async (built lazily:
+        # only pipelined batchers use it); FIFO order is the dispatch
+        # ordering contract
+        self._dispatch_pool = None
         self.decode_steps = 0
         self.prefix_rows_reused = 0
         self.prefix_rows_restored = 0
@@ -736,104 +807,110 @@ class TPUEngine:
 
     # -- jitted cores -------------------------------------------------------
 
+    def _decode_body(self, params, st: DecodeState, sub, tables=None,
+                     mask=None):
+        """ONE decode step against whichever cache layout this engine runs
+        — the shared body of the per-size scan graphs (``_step_impl``) and
+        the unified dynamic-n loop graph (``_unified_impl``). Only the
+        model call differs between the dense, int8-KV and paged layouts;
+        sampling, history gating and the state rebuild are shared.
+        ``mask`` [S, V] fp32 adds to the logits before sampling — the
+        grammar-constraint hook (engine/jsonmode.py), step_masked only."""
+        if self.paged:
+            scales = (
+                (st["k_s"], st["v_s"]) if self.quant_cache else None
+            )
+            out = model.decode_step_paged(
+                params,
+                self.cfg,
+                st["last_tokens"],
+                st["lengths"],
+                st["k"],
+                st["v"],
+                tables,
+                kernels=self._kernels,
+                cache_scales=scales,
+                active=st["active"],
+                moe_impl=self._moe_impl,
+                qmm=self._qmm_impl,
+                pool_impl=self._pool_impl,
+            )
+            if self.quant_cache:
+                logits, k, v, (k_s, v_s) = out
+            else:
+                logits, k, v = out
+        elif self.quant_cache:
+            logits, k, v, (k_s, v_s) = model.decode_step(
+                params,
+                self.cfg,
+                st["last_tokens"],
+                st["lengths"],
+                st["k"],
+                st["v"],
+                kernels=self._kernels,
+                cache_scales=(st["k_s"], st["v_s"]),
+                active=st["active"],
+                moe_impl=self._moe_impl,
+                qmm=self._qmm_impl,
+            )
+        else:
+            logits, k, v = model.decode_step(
+                params,
+                self.cfg,
+                st["last_tokens"],
+                st["lengths"],
+                st["k"],
+                st["v"],
+                kernels=self._kernels,
+                active=st["active"],
+                attn_impl=self._attn_impl,
+                moe_impl=self._moe_impl,
+                qmm=self._qmm_impl,
+            )
+        if mask is not None:
+            logits = logits + mask
+        next_tokens = sampling.sample(
+            logits, sub, st["temps"], st["top_ps"],
+            exact=mask is not None,
+        )
+        slots = jnp.arange(self.num_slots)
+        # new token's history col is lengths+1 (<= C, inside the pad);
+        # inactive slots — retired or MID-CHUNKED-PREFILL — write to the
+        # sacrificial last pad col instead, or interleaved dispatches
+        # would scribble over prompt tokens the chunk admission already
+        # wrote (K/V has the same gate via the sacrificial cache row)
+        hcol = jnp.where(
+            st["active"],
+            st["lengths"] + 1,
+            st["history"].shape[1] - 1,
+        )
+        st = {
+            "k": k,
+            "v": v,
+            "lengths": jnp.minimum(st["lengths"] + 1, self.max_context - 1),
+            "last_tokens": next_tokens,
+            "temps": st["temps"],
+            "top_ps": st["top_ps"],
+            "active": st["active"],
+            "history": (
+                st["history"].at[slots, hcol].set(next_tokens)
+                if self.track_history else st["history"]
+            ),
+            "key": st["key"],
+        }
+        if self.quant_cache:
+            st["k_s"] = k_s
+            st["v_s"] = v_s
+        return st, next_tokens
+
     def _step_impl(self, params, state: DecodeState, n_steps: int, tables=None,
                    mask=None):
-        """The decode scan. ``tables`` (paged engines only) is the host
-        allocator's [S, MB] block->page map riding along with the dispatch;
-        only the model call differs between the dense, int8-KV and paged
-        cache layouts — sampling, history gating and the state rebuild are
-        shared. ``mask`` [S, V] fp32 adds to the logits before sampling —
-        the grammar-constraint hook (engine/jsonmode.py), step_masked only.
-        """
+        """The decode scan: ``n_steps`` applications of ``_decode_body``
+        in one dispatch (one traced body, XLA while-loop — never an
+        unrolled graph)."""
 
         def one(carry, sub):
-            st = carry
-            if self.paged:
-                scales = (
-                    (st["k_s"], st["v_s"]) if self.quant_cache else None
-                )
-                out = model.decode_step_paged(
-                    params,
-                    self.cfg,
-                    st["last_tokens"],
-                    st["lengths"],
-                    st["k"],
-                    st["v"],
-                    tables,
-                    kernels=self._kernels,
-                    cache_scales=scales,
-                    active=st["active"],
-                    moe_impl=self._moe_impl,
-                    qmm=self._qmm_impl,
-                    pool_impl=self._pool_impl,
-                )
-                if self.quant_cache:
-                    logits, k, v, (k_s, v_s) = out
-                else:
-                    logits, k, v = out
-            elif self.quant_cache:
-                logits, k, v, (k_s, v_s) = model.decode_step(
-                    params,
-                    self.cfg,
-                    st["last_tokens"],
-                    st["lengths"],
-                    st["k"],
-                    st["v"],
-                    kernels=self._kernels,
-                    cache_scales=(st["k_s"], st["v_s"]),
-                    active=st["active"],
-                    moe_impl=self._moe_impl,
-                    qmm=self._qmm_impl,
-                )
-            else:
-                logits, k, v = model.decode_step(
-                    params,
-                    self.cfg,
-                    st["last_tokens"],
-                    st["lengths"],
-                    st["k"],
-                    st["v"],
-                    kernels=self._kernels,
-                    active=st["active"],
-                    attn_impl=self._attn_impl,
-                    moe_impl=self._moe_impl,
-                    qmm=self._qmm_impl,
-                )
-            if mask is not None:
-                logits = logits + mask
-            next_tokens = sampling.sample(
-                logits, sub, st["temps"], st["top_ps"],
-                exact=mask is not None,
-            )
-            slots = jnp.arange(self.num_slots)
-            # new token's history col is lengths+1 (<= C, inside the pad);
-            # inactive slots — retired or MID-CHUNKED-PREFILL — write to the
-            # sacrificial last pad col instead, or interleaved dispatches
-            # would scribble over prompt tokens the chunk admission already
-            # wrote (K/V has the same gate via the sacrificial cache row)
-            hcol = jnp.where(
-                st["active"],
-                st["lengths"] + 1,
-                st["history"].shape[1] - 1,
-            )
-            st = {
-                "k": k,
-                "v": v,
-                "lengths": jnp.minimum(st["lengths"] + 1, self.max_context - 1),
-                "last_tokens": next_tokens,
-                "temps": st["temps"],
-                "top_ps": st["top_ps"],
-                "active": st["active"],
-                "history": (
-                    st["history"].at[slots, hcol].set(next_tokens)
-                    if self.track_history else st["history"]
-                ),
-                "key": st["key"],
-            }
-            if self.quant_cache:
-                st["k_s"] = k_s
-                st["v_s"] = v_s
-            return st, next_tokens
+            return self._decode_body(params, carry, sub, tables, mask)
 
         # one batched split for the whole dispatch instead of a split per
         # step: keeps the threefry chain out of the scan's serial carry
@@ -843,6 +920,29 @@ class TPUEngine:
         state = dict(state, key=keys[0])
         state, tokens = jax.lax.scan(one, state, keys[1:])
         return state, tokens  # tokens [n_steps, S]
+
+    def _unified_impl(self, params, state: DecodeState, n, max_steps: int,
+                      tables=None):
+        """Dynamic-step decode loop: run ``n`` (a traced operand, n <=
+        max_steps) steps of ``_decode_body`` under one fori_loop, emitting
+        into a fixed [max_steps, S] token buffer — ONE compiled graph
+        serves every chunk size the batcher dispatches. Rows past n stay
+        zero and are sliced off on the host (PendingDecode.wait). The key
+        fanout is max_steps+1 regardless of n, so sampled sequences differ
+        from the per-size scan graphs (greedy output is identical)."""
+        keys = jax.random.split(state["key"], max_steps + 1)
+        state = dict(state, key=keys[0])
+
+        def body(i, carry):
+            st, out = carry
+            st, tok = self._decode_body(params, st, keys[i + 1], tables)
+            return st, out.at[i].set(tok)
+
+        out0 = jnp.zeros((max_steps, self.num_slots), jnp.int32)
+        state, tokens = jax.lax.fori_loop(
+            0, jnp.minimum(n, max_steps), body, (state, out0)
+        )
+        return state, tokens  # tokens [max_steps, S]; rows [n:] are zeros
 
     def _spec_impl(
         self, params, state: DecodeState, n_rounds: int, draft_len: int,
@@ -1184,49 +1284,257 @@ class TPUEngine:
 
         return wrapper
 
+    # -- jit builders -------------------------------------------------------
+    # One builder per graph kind, shared by the LAZY getters (compile on
+    # first dispatch, timed by _instrument_compile) and the AOT warmup
+    # (jit.lower(...).compile() against the live state avals — traces and
+    # compiles WITHOUT dispatching, so warmup needs no synthetic prompts,
+    # no page allocations, and no prefix-index/host-store rollbacks).
+
+    def _make_step_jit(self, n_steps: int):
+        if self.paged:
+            return jax.jit(
+                lambda p, s, t: self._step_impl(p, s, n_steps, t),
+                donate_argnums=(1,),
+            )
+        return jax.jit(
+            lambda p, s: self._step_impl(p, s, n_steps),
+            donate_argnums=(1,),
+        )
+
+    def _make_unified_jit(self, max_steps: int):
+        if self.paged:
+            return jax.jit(
+                lambda p, s, t, n: self._unified_impl(p, s, n, max_steps, t),
+                donate_argnums=(1,),
+            )
+        return jax.jit(
+            lambda p, s, n: self._unified_impl(p, s, n, max_steps),
+            donate_argnums=(1,),
+        )
+
+    def _make_masked_jit(self):
+        if self.paged:
+            return jax.jit(
+                lambda p, s, t, m: self._step_impl(p, s, 1, t, m),
+                donate_argnums=(1,),
+            )
+        return jax.jit(
+            lambda p, s, m: self._step_impl(p, s, 1, None, m),
+            donate_argnums=(1,),
+        )
+
+    def _make_spec_jit(self, key: Tuple[int, int, int]):
+        if self.paged:
+            return jax.jit(
+                lambda p, s, t: self._spec_impl(p, s, *key, tables=t),
+                donate_argnums=(1,),
+            )
+        return jax.jit(
+            lambda p, s: self._spec_impl(p, s, *key),
+            donate_argnums=(1,),
+        )
+
+    def _make_prefill_jit(self):
+        impl = self._prefill_impl_paged if self.paged else self._prefill_impl
+        return jax.jit(impl, donate_argnums=(1,))
+
+    def _make_chunk_jit(self, final: bool):
+        impl = self._final_chunk_impl if final else self._prefill_chunk_impl
+        return jax.jit(impl, donate_argnums=(1,))
+
+    @staticmethod
+    def _make_hist_jit():
+        def impl(state, tokens, slot, start):
+            new = dict(state)
+            new["history"] = jax.lax.dynamic_update_slice(
+                state["history"], tokens, (slot, start)
+            )
+            return new
+
+        return jax.jit(impl, donate_argnums=(0,))
+
+    # -- AOT compilation (warmup / readiness gate) --------------------------
+
+    def _compile_aot(self, kind: str, store: Dict, key, jitfn,
+                     example_args) -> None:
+        """AOT-compile one graph against the live avals of
+        ``example_args`` and store the compiled executable where the
+        dispatch path looks it up. lower()+compile() traces but never
+        executes — no device state moves, nothing donates — so the whole
+        serving surface can warm behind the readiness gate in compile
+        time alone. Counts the same compile-event accounting a lazy
+        first dispatch would; if this backend combination cannot AOT-
+        lower the graph, fall back to the lazy instrumented wrapper (the
+        first real dispatch then compiles, visibly)."""
+        if key in store:
+            return
+        t0 = time.perf_counter()
+        try:
+            fn = jitfn.lower(*example_args).compile()
+        except Exception:  # noqa: BLE001 - lazy compile still serves
+            log.exception(
+                "AOT lowering failed for %s graph %r; deferring to "
+                "first-dispatch compile", kind, key,
+            )
+            store[key] = self._instrument_compile(jitfn, kind)
+            return
+        dt = time.perf_counter() - t0
+        obs.ENGINE_XLA_COMPILES.labels(model=self.cfg.name, kind=kind).inc()
+        self.compile_events += 1
+        self.compile_seconds += dt
+        obs.ENGINE_XLA_COMPILE_SECONDS.labels(
+            model=self.cfg.name, kind=kind
+        ).observe(dt)
+        store[key] = fn
+
+    def _step_example(self) -> tuple:
+        if self.paged:
+            return (self.params, self.state,
+                    jnp.asarray(self.allocator.tables))
+        return (self.params, self.state)
+
+    def compile_step_fn(self, n_steps: int) -> None:
+        """Ensure the ``n_steps`` decode graph exists WITHOUT dispatching
+        (the batcher calls this for its chunk sizes when it attaches to a
+        warmed engine; warmup calls it for every serving step size)."""
+        if self.unified_step:
+            self._unified_fn(n_steps, aot=True)
+        elif n_steps not in self._step_fns:
+            self._compile_aot(
+                "step", self._step_fns, n_steps,
+                self._make_step_jit(n_steps), self._step_example(),
+            )
+
+    def compile_masked_fn(self) -> None:
+        if "masked" in self._step_fns:
+            return
+        mask = jnp.zeros((self.num_slots, self.cfg.vocab_size), jnp.float32)
+        self._compile_aot(
+            "masked", self._step_fns, "masked", self._make_masked_jit(),
+            self._step_example() + (mask,),
+        )
+
+    def compile_spec_fn(self, n_rounds: int, draft_len: int,
+                        ngram: int) -> None:
+        key = (n_rounds, draft_len, ngram)
+        if key in self._spec_fns or not self.spec_supported \
+                or not self.track_history:
+            return
+        self._compile_aot(
+            "spec", self._spec_fns, key, self._make_spec_jit(key),
+            self._step_example(),
+        )
+
+    def compile_prefill_fn(self, bucket: int) -> None:
+        if bucket in self._prefill_fns:
+            return
+        args = (
+            self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
+            jnp.int32(0), jnp.int32(1), jnp.float32(0.0), jnp.float32(1.0),
+        )
+        if self.paged:
+            args = args + (jnp.asarray(self.allocator.tables[0]),)
+        self._compile_aot(
+            "prefill", self._prefill_fns, bucket, self._make_prefill_jit(),
+            args,
+        )
+
+    def compile_chunk_fn(self, bucket: int, final: bool) -> None:
+        key = (bucket, final)
+        if key in self._chunk_fns:
+            return
+        args = [
+            self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
+            jnp.int32(0), jnp.int32(0),
+        ]
+        if final:
+            args += [jnp.int32(1), jnp.int32(1), jnp.float32(0.0),
+                     jnp.float32(1.0)]
+        if self.paged:
+            args.append(jnp.asarray(self.allocator.tables[0]))
+        self._compile_aot(
+            "chunk", self._chunk_fns, key, self._make_chunk_jit(final),
+            tuple(args),
+        )
+
+    def compile_hist_fn(self, bucket: int) -> None:
+        key = ("hist", bucket)
+        if key in self._prefill_fns:
+            return
+        args = (
+            self.state, jnp.zeros((1, bucket), jnp.int32), jnp.int32(0),
+            jnp.int32(0),
+        )
+        self._compile_aot("hist", self._prefill_fns, key,
+                          self._make_hist_jit(), args)
+
+    def compile_restore_fn(self, nb: int) -> None:
+        if nb in self._restore_fns or not self.paged:
+            return
+        cfg, P = self.cfg, self.allocator.page_size
+        z = jnp.zeros(
+            (cfg.num_layers, nb, P, cfg.num_kv_heads, cfg.head_dim),
+            self.state["k"].dtype,
+        )
+        args = [self.state, z, z]
+        if self.quant_cache:
+            s = jnp.zeros((cfg.num_layers, nb, P, cfg.num_kv_heads),
+                          jnp.float32)
+            args += [s, s]
+        args.append(jnp.zeros((nb,), jnp.int32))
+        self._compile_aot(
+            "restore", self._restore_fns, nb, self._make_restore_jit(),
+            tuple(args),
+        )
+
+    # -- lazy getters (unwarmed engines compile on first dispatch) ----------
+
     def _step_fn(self, n_steps: int):
         fn = self._step_fns.get(n_steps)
         if fn is None:
-            if self.paged:
-                fn = jax.jit(
-                    lambda p, s, t: self._step_impl(p, s, n_steps, t),
-                    donate_argnums=(1,),
-                )
-            else:
-                fn = jax.jit(
-                    lambda p, s: self._step_impl(p, s, n_steps),
-                    donate_argnums=(1,),
-                )
-            fn = self._instrument_compile(fn, "step")
+            fn = self._instrument_compile(self._make_step_jit(n_steps), "step")
             self._step_fns[n_steps] = fn
         return fn
+
+    def _unified_fn(self, n_steps: int, aot: bool = False):
+        """The dynamic-n decode graph serving ``n_steps`` (unified_step
+        mode): one graph per power-of-two max-steps bound, grown on
+        demand. Returns (fn, max_steps)."""
+        m = self._unified_max
+        if m < n_steps:
+            m = 1
+            while m < n_steps:
+                m *= 2
+        key = ("uni", m)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            jitfn = self._make_unified_jit(m)
+            if aot:
+                self._compile_aot(
+                    "step", self._step_fns, key, jitfn,
+                    self._step_example() + (jnp.int32(1),),
+                )
+                fn = self._step_fns[key]
+            else:
+                fn = self._instrument_compile(jitfn, "step")
+                self._step_fns[key] = fn
+            self._unified_max = m
+        return fn, m
 
     def _masked_step_fn(self):
         """1-step decode with an additive per-slot logits mask (grammar-
         constrained decoding); same donated state contract as _step_fn."""
         fn = self._step_fns.get("masked")
         if fn is None:
-            if self.paged:
-                fn = jax.jit(
-                    lambda p, s, t, m: self._step_impl(p, s, 1, t, m),
-                    donate_argnums=(1,),
-                )
-            else:
-                fn = jax.jit(
-                    lambda p, s, m: self._step_impl(p, s, 1, None, m),
-                    donate_argnums=(1,),
-                )
-            fn = self._instrument_compile(fn, "masked")
+            fn = self._instrument_compile(self._make_masked_jit(), "masked")
             self._step_fns["masked"] = fn
         return fn
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            impl = self._prefill_impl_paged if self.paged else self._prefill_impl
-            fn = self._instrument_compile(
-                jax.jit(impl, donate_argnums=(1,)), "prefill"
-            )
+            fn = self._instrument_compile(self._make_prefill_jit(), "prefill")
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -1234,17 +1542,7 @@ class TPUEngine:
         key = (n_rounds, draft_len, ngram)
         fn = self._spec_fns.get(key)
         if fn is None:
-            if self.paged:
-                fn = jax.jit(
-                    lambda p, s, t: self._spec_impl(p, s, *key, tables=t),
-                    donate_argnums=(1,),
-                )
-            else:
-                fn = jax.jit(
-                    lambda p, s: self._spec_impl(p, s, *key),
-                    donate_argnums=(1,),
-                )
-            fn = self._instrument_compile(fn, "spec")
+            fn = self._instrument_compile(self._make_spec_jit(key), "spec")
             self._spec_fns[key] = fn
         return fn
 
@@ -1252,10 +1550,7 @@ class TPUEngine:
         key = (bucket, final)
         fn = self._chunk_fns.get(key)
         if fn is None:
-            impl = self._final_chunk_impl if final else self._prefill_chunk_impl
-            fn = self._instrument_compile(
-                jax.jit(impl, donate_argnums=(1,)), "chunk"
-            )
+            fn = self._instrument_compile(self._make_chunk_jit(final), "chunk")
             self._chunk_fns[key] = fn
         return fn
 
@@ -1263,14 +1558,7 @@ class TPUEngine:
         key = ("hist", bucket)
         fn = self._prefill_fns.get(key)
         if fn is None:
-            def impl(state, tokens, slot, start):
-                new = dict(state)
-                new["history"] = jax.lax.dynamic_update_slice(
-                    state["history"], tokens, (slot, start)
-                )
-                return new
-
-            fn = jax.jit(impl, donate_argnums=(0,))
+            fn = self._make_hist_jit()
             self._prefill_fns[key] = fn
         return fn
 
@@ -1414,23 +1702,26 @@ class TPUEngine:
         back to normal prefill."""
         fn = self._restore_fns.get(bucket)
         if fn is None:
-            if self.quant_cache:
-                def impl(state, kh, vh, ksh, vsh, pages):
-                    new = dict(state)
-                    new["k"] = state["k"].at[:, pages].set(kh)
-                    new["v"] = state["v"].at[:, pages].set(vh)
-                    new["k_s"] = state["k_s"].at[:, pages].set(ksh)
-                    new["v_s"] = state["v_s"].at[:, pages].set(vsh)
-                    return new
-            else:
-                def impl(state, kh, vh, pages):
-                    new = dict(state)
-                    new["k"] = state["k"].at[:, pages].set(kh)
-                    new["v"] = state["v"].at[:, pages].set(vh)
-                    return new
-            fn = self._instrument_compile(jax.jit(impl), "restore")
+            fn = self._instrument_compile(self._make_restore_jit(), "restore")
             self._restore_fns[bucket] = fn
         return fn
+
+    def _make_restore_jit(self):
+        if self.quant_cache:
+            def impl(state, kh, vh, ksh, vsh, pages):
+                new = dict(state)
+                new["k"] = state["k"].at[:, pages].set(kh)
+                new["v"] = state["v"].at[:, pages].set(vh)
+                new["k_s"] = state["k_s"].at[:, pages].set(ksh)
+                new["v_s"] = state["v_s"].at[:, pages].set(vsh)
+                return new
+        else:
+            def impl(state, kh, vh, pages):
+                new = dict(state)
+                new["k"] = state["k"].at[:, pages].set(kh)
+                new["v"] = state["v"].at[:, pages].set(vh)
+                return new
+        return jax.jit(impl)
 
     def _restore_from_host(self, slot: int, entries) -> List[int]:
         """Allocate landing pages for a host-tier chain hit, scatter the
@@ -1728,22 +2019,69 @@ class TPUEngine:
         ``self.active`` are meaningful. Lengths advance for every slot
         (fixed-shape graph), clamped at the cache end.
         """
+        tokens, _ = self._step_dispatch(n_steps)
+        return tokens
+
+    def _step_dispatch(
+        self, n_steps: int, started: Optional[threading.Event] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The decode dispatch body: lock, graph call (donated state
+        swap), host-length advance, then the blocking device->host token
+        readback OUTSIDE the lock. Returns (tokens [n_steps, S] host
+        array, post-dispatch host lengths). ``started`` (the step_async
+        worker path) is set the moment the engine lock is held, so a
+        caller can fence later engine calls behind this dispatch."""
         with self._lock:
+            if started is not None:
+                started.set()
+            tables = ()
             if self.paged:
                 self._back_active_slots(n_steps)
-                self.state, tokens = self._step_fn(n_steps)(
-                    self.params, self.state, jnp.asarray(self.allocator.tables)
+                tables = (jnp.asarray(self.allocator.tables),)
+            if self.unified_step:
+                fn, _ = self._unified_fn(n_steps)
+                self.state, tokens = fn(
+                    self.params, self.state, *tables, jnp.int32(n_steps)
                 )
             else:
                 self.state, tokens = self._step_fn(n_steps)(
-                    self.params, self.state
+                    self.params, self.state, *tables
                 )
             self.decode_steps += n_steps
             self._obs_decode_steps.inc(n_steps)
             self._host_lengths = np.minimum(
                 self._host_lengths + n_steps, self.max_context - 1
             )
-            return np.asarray(tokens)
+            lengths = self._host_lengths.copy()
+        return np.asarray(tokens)[:n_steps], lengths
+
+    def step_async(self, n_steps: int = 1) -> PendingDecode:
+        """Run ``n_steps`` batched decode steps on the engine's dispatch
+        worker thread and return WITHOUT blocking
+        (``PendingDecode.wait()`` yields the host [n_steps, num_slots]
+        array). The caller's thread is free through the whole dispatch —
+        graph call AND token readback — so the pipelined continuous
+        batcher (AIOS_TPU_DECODE_PIPELINE) emits/detokenizes/retires
+        dispatch N's tokens while dispatch N+1 executes. A PoolExhausted
+        from backing the slots surfaces at ``wait()`` with engine state
+        untouched, exactly like the sync path.
+
+        Dispatches are FIFO (single worker) and serialize with every
+        other engine call through the engine lock; use
+        ``wait_started()`` before issuing engine calls that must order
+        AFTER this dispatch."""
+        if self._dispatch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._dispatch_pool = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=f"decode-dispatch-{self.cfg.name}",
+            )
+        started = threading.Event()
+        fut = self._dispatch_pool.submit(
+            self._step_dispatch, n_steps, started
+        )
+        return PendingDecode(fut, n_steps, started)
 
     def step_masked(self, mask: np.ndarray) -> np.ndarray:
         """One batched decode step with a per-slot ADDITIVE logits mask
@@ -1767,7 +2105,10 @@ class TPUEngine:
             self._host_lengths = np.minimum(
                 self._host_lengths + 1, self.max_context - 1
             )
-            return np.asarray(tokens)
+        # readback OUTSIDE the lock (like _step_dispatch): concurrent
+        # engine calls — force_pending_token, release, overlap probes that
+        # do take the lock — need not wait for this dispatch to finish
+        return np.asarray(tokens)
 
     def force_pending_token(self, slot: int, token_id: int) -> None:
         """Replace ``slot``'s pending (sampled-but-not-yet-consumed) token.
@@ -1919,6 +2260,12 @@ class TPUEngine:
             # a timed-out join the straggler's late inserts are bounded
             # by the store budget and freed when the engine is collected
             self.host_store.clear()
+        if self._dispatch_pool is not None:
+            # drain the decode-dispatch worker BEFORE dropping the state:
+            # a queued dispatch running against cleared state would die on
+            # a confusing error inside the worker instead of here
+            self._dispatch_pool.shutdown(wait=True)
+            self._dispatch_pool = None
         with self._lock:
             self._step_fns.clear()
             self._prefill_fns.clear()
@@ -1945,152 +2292,90 @@ class TPUEngine:
         step_sizes: Tuple[int, ...] = (1, 2, 8, 16),
         prefill_chunk: Optional[int] = None,  # None -> prefill_chunk_default
         masked_step: bool = False,  # also compile the grammar-masked step
+        spec_sizes: Tuple[int, ...] = (),  # speculative round counts
+        spec_draft_len: int = 7,
+        spec_ngram: int = 3,
     ) -> None:
-        """Pre-compile decode + prefill buckets (LoadModel readiness gate —
-        the reference's /health polling equivalent, model_manager.rs:222-263;
-        without this the first Infer would eat 20-40 s of XLA compile).
+        """AOT-compile every graph the serving path can hit (LoadModel
+        readiness gate — the reference's /health polling equivalent,
+        model_manager.rs:222-263; without this the first Infer would eat
+        20-40 s of XLA compile).
 
-        Also compiles the chunked-admission graphs (mid chunk + every final
-        bucket <= ``prefill_chunk``) so the first long prompt after the
-        readiness gate doesn't stall active decode on an XLA compile inside
-        the scheduler thread. Pass the batcher's chunk size if it overrides
-        the shared default, or 0 to skip.
+        Dispatch-free: each graph is ``jit.lower(...).compile()``d against
+        the live param/state avals, so warmup moves no device state — no
+        synthetic prompts, no page allocations, no prefix-index or
+        host-store pollution to roll back — and ``engine.stats()`` compile
+        counters stay FLAT afterwards (the no-compile-after-warmup
+        regression gate in tests/test_decode_pipeline.py).
 
-        Prefix matching is suspended for the duration: warmup's synthetic
-        prompts must compile every monolithic prefill bucket, and a
-        self-match would short-circuit the larger buckets onto the chunked
-        path (and pollute the index with junk blocks). The host-tier
-        spill hook is detached for the same reason — a pressure reclaim
-        during warmup admissions must not demote synthetic blocks into
-        the host store.
+        Coverage: every power-of-two prefill bucket the pool can back, the
+        chunked-admission graphs (mid chunk + every final bucket <=
+        ``prefill_chunk``; pass the batcher's size if it overrides the
+        shared default, 0 to skip), the prefix-HIT graphs (history
+        backfill per bucket + the prefix-chunk tail graphs), every
+        ``step_sizes`` decode graph (ONE dynamic-n graph in unified_step
+        mode), the grammar-masked step when ``masked_step``, speculative
+        round graphs for ``spec_sizes``, and the host-tier restore
+        scatter buckets.
         """
-        prefix_index, self.prefix_index = self.prefix_index, None
-        spill = None
-        if prefix_index is not None and prefix_index.spill is not None:
-            spill, prefix_index.spill = prefix_index.spill, None
-        try:
-            try:
-                self._warmup_graphs(step_sizes, prefill_chunk)
-                if masked_step:  # json-mode deployments dispatch step_masked
-                    self.step_masked(
-                        np.zeros(
-                            (self.num_slots, self.cfg.vocab_size), np.float32
-                        )
-                    )
-            finally:
-                self.prefix_index = prefix_index
-            if self.prefix_index is not None:
-                self._warmup_prefix_graphs()
-                self._warmup_restore_graphs()
-        finally:
-            # ONE finally covers every phase: a caller that survives a
-            # warmup failure and keeps serving must not end up with the
-            # spill hook silently detached (a dead host tier for the
-            # process lifetime) or warmup junk resident in the store
-            if spill is not None:
-                prefix_index.spill = spill
-            if self.host_store is not None:
-                self.host_store.clear()
-
-    def _warmup_prefix_graphs(self) -> None:
-        """Compile everything a prefix HIT can dispatch — the
-        history-backfill graphs and the tail's chunk graphs — so the first
-        resubmitted agent preamble after the readiness gate doesn't stall
-        live requests on an XLA compile (the TTFT-stall class the warmup
-        bucket fix addressed for cold prompts)."""
-        for b in self.buckets:
-            self._write_history(0, [0] * (b // 2 + 1))
-        pc = self._prefix_chunk
-        # Drive real admissions: the first registers its blocks, each later
-        # one matches `pc` rows and its tail lands in a distinct final
-        # bucket; the last forces one mid chunk too. Cheap when the normal
-        # chunk warmup already compiled these graphs; essential when the
-        # batcher's chunk size and the prefix chunk size diverge.
-        tails = [b // 2 + 1 for b in self.buckets if b <= pc]
-        tails.append(pc + 17)
-        for tail in tails:
-            n = pc + tail
-            if n > self.max_context - 1:
-                continue
-            if self.allocator.blocks_for(n) > self.allocator.capacity_blocks():
-                continue  # pool too small for this prompt either way
-            self.prefill(0, [7] * n, temperature=0.0)
-            self.release(0)
-        self.prefix_index.clear()  # drop the synthetic warmup blocks
-
-    def _warmup_restore_graphs(self) -> None:
-        """Compile the host-tier restore scatters (every power-of-two
-        page bucket the pool can hold) behind the readiness gate, so the
-        first spill->restore cycle mid-serving doesn't stall live
-        requests on an XLA compile. The warmup writes land on the
-        sacrificial page 0, which is never read."""
-        if self.host_store is None:
-            return
-        P = self.allocator.page_size
-        cfg = self.cfg
-        # a restore chain is bounded by the prompt's full blocks, NOT the
-        # pool: capping at capacity alone would compile (and transiently
-        # allocate zero-KV staging buffers for) buckets far bigger than
-        # any restore can request on an auto-sized pool
-        cap = min(
-            self.allocator.capacity_blocks(),
-            (self.max_context - 1) // P,
-        )
-        nb = 1
-        while True:
-            pages = jnp.zeros((nb,), jnp.int32)
-            z = jnp.zeros(
-                (cfg.num_layers, nb, P, cfg.num_kv_heads, cfg.head_dim),
-                self.state["k"].dtype,
-            )
-            args = [z, z]
-            if self.quant_cache:
-                s = jnp.zeros(
-                    (cfg.num_layers, nb, P, cfg.num_kv_heads), jnp.float32
-                )
-                args += [s, s]
-            with self._lock:
-                self.state = self._restore_fn(nb)(self.state, *args, pages)
-            if nb >= cap:
-                # a restore can round up to the first power of two AT or
-                # ABOVE capacity (e.g. 10 pages -> bucket 16 on a 15-page
-                # pool) — stopping at nb <= cap would leave exactly that
-                # largest bucket to compile mid-serving
-                break
-            nb *= 2
-
-    def _warmup_graphs(self, step_sizes, prefill_chunk) -> None:
+        t0 = time.perf_counter()
+        before = self.compile_events
         for bucket in self.buckets:
             if self.paged and self.allocator.blocks_for(
                 bucket // 2 + 1
-            ) > self.allocator.free_pages_for(0):
+            ) > self.allocator.capacity_blocks():
                 continue  # pool can't back prompts of this bucket anyway
-            # length in (previous_bucket, bucket] so bucket_for() actually
-            # selects THIS bucket — a fixed short prompt would bucket to 16
-            # every iteration and leave the larger prefill graphs uncompiled
-            # (the readiness-gate bug the agent-TTFT bench exposed: the
-            # first real prompt then eats the compile mid-serving)
-            self.prefill(0, [1] * (bucket // 2 + 1), temperature=0.0)
-            self.release(0)
+            self.compile_prefill_fn(bucket)
         ck = self.prefill_chunk_default if prefill_chunk is None else prefill_chunk
-        if not ck:
-            ck = None
-        if ck is not None and ck in self.buckets and self.max_context % ck == 0:
+        if ck and ck in self.buckets and self.max_context % ck == 0:
+            self.compile_chunk_fn(ck, final=False)
             for b in self.buckets:
                 if b > ck:
                     break
-                # remainder in (b/2, b] so bucket_for(remainder) == b
-                n = min(ck + b // 2 + 1, self.max_context - 1)
-                if self.paged and self.allocator.blocks_for(
-                    n
-                ) > self.allocator.free_pages_for(0):
-                    continue
-                pc = self.start_chunked_prefill(0, [1] * n, chunk=ck)
-                while pc.step() is None:
-                    pass
-                self.release(0)
-        for n in step_sizes:
-            self.step(n)
+                self.compile_chunk_fn(b, final=True)
+        if self.prefix_index is not None:
+            # the HIT path: history backfill for the matched rows + the
+            # tail's chunk graphs at the prefix chunk size (distinct from
+            # the batcher's chunk size when they diverge)
+            for b in self.buckets:
+                self.compile_hist_fn(b)
+            pc = self._prefix_chunk
+            if pc:
+                self.compile_chunk_fn(pc, final=False)
+                for b in self.buckets:
+                    if b > pc:
+                        break
+                    self.compile_chunk_fn(b, final=True)
+        # largest first: in unified_step mode the first compile sets
+        # _unified_max, so ONE dynamic-n graph serves every smaller size
+        # (ascending order would compile one graph per power of two)
+        for n in sorted(step_sizes, reverse=True):
+            self.compile_step_fn(n)
+        if masked_step:  # json-mode deployments dispatch step_masked
+            self.compile_masked_fn()
+        for n in spec_sizes:
+            self.compile_spec_fn(n, spec_draft_len, spec_ngram)
+        if self.host_store is not None:
+            # a restore chain is bounded by the prompt's full blocks AND
+            # the pool; the last bucket rounds UP past capacity (a 10-page
+            # restore on a 15-page pool buckets to 16 — stopping at
+            # nb <= cap would leave exactly that bucket to compile
+            # mid-serving)
+            cap = min(
+                self.allocator.capacity_blocks(),
+                (self.max_context - 1) // self.allocator.page_size,
+            )
+            nb = 1
+            while True:
+                self.compile_restore_fn(nb)
+                if nb >= cap:
+                    break
+                nb *= 2
+        log.info(
+            "%s: warmup AOT-compiled %d graph(s) in %.1fs",
+            self.cfg.name, self.compile_events - before,
+            time.perf_counter() - t0,
+        )
 
     # -- convenience (tests, single-shot CLI) -------------------------------
 

@@ -537,6 +537,10 @@ class JsonMaskCache:
         return dev
 
     def zeros_row(self):
+        """Device-resident all-zeros (unconstrained) row. The batcher no
+        longer stacks this per unconstrained slot — it scatters only the
+        constrained rows into a cached [slots, vocab] zeros base — but
+        single-row callers (tests, external grammars) keep the helper."""
         import jax.numpy as jnp
 
         got = self._dev.get("zeros")

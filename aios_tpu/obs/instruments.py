@@ -122,6 +122,34 @@ ENGINE_XLA_COMPILE_SECONDS = Histogram(
     buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0),
 )
 
+# -- decode dispatch loop (pipelined batcher, AIOS_TPU_DECODE_PIPELINE) ----
+# The dispatch family watches the host<->device seam of the decode loop:
+# how long the host spends between consecutive decode dispatches (the
+# device-idle window in the sync loop — the pipeline exists to hide it),
+# whether a pipelined dispatch is currently in flight, and how often the
+# pipeline had to drain early (constrained ticks, evictions, idle).
+
+ENGINE_DISPATCH_HOST_GAP = Histogram(
+    "aios_tpu_engine_dispatch_host_gap_seconds",
+    "Host wall time between consecutive decode dispatches (emit/detok/"
+    "retire/bookkeeping; the device idles through this unless pipelined)",
+    ("model",),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 1.0),
+)
+ENGINE_DISPATCH_INFLIGHT = Gauge(
+    "aios_tpu_engine_dispatch_inflight_total",
+    "Pipelined decode dispatches enqueued but not yet consumed, summed "
+    "over the model's replica batchers (0..replicas; scrape-time)",
+    ("model",),
+)
+ENGINE_DISPATCH_FLUSHES = Counter(
+    "aios_tpu_engine_dispatch_flushes_total",
+    "Pipelined decode flushes by cause "
+    "(constrained|spec|evict|idle)",
+    ("model", "cause"),
+)
+
 # -- prefix-cache host spill tier (engine/paged.py HostPageStore) ----------
 # Monotonic store counters surface as count-valued gauges read at scrape
 # time (the ENGINE_PREFIX_* pattern); only the restore latency is a true

@@ -616,6 +616,10 @@ class ModelManager:
                     if self.warm_compile:
                         # json-mode deployments dispatch the grammar-masked
                         # step; compile it behind the readiness gate too
+                        # (AOT, no dispatch). Speculative round graphs are
+                        # covered when the pool's batchers attach below —
+                        # ContinuousBatcher AOT-compiles its ACTUAL chunk
+                        # sizes, still before STATE_READY
                         from .service import json_mode_forced
 
                         engine.warmup(masked_step=json_mode_forced())

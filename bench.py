@@ -675,6 +675,119 @@ def bench_host_tier():
     }
 
 
+def bench_dispatch():
+    """Pipelined-decode A/B through the production continuous batcher
+    (AIOS_TPU_DECODE_PIPELINE): 8 concurrent greedy requests per wave,
+    1-step dispatches — the dispatch-bound regime the pipeline targets
+    (every decode chunk pays the full Python→dispatch→host-sync round
+    trip) — with identical token streams asserted across arms.
+
+    Both arms stay resident and waves ALTERNATE off/on; the headline is
+    the MEDIAN of per-pair tok/s ratios. This container's CPU
+    availability swings ~2x on a seconds timescale (shared cores +
+    cgroup throttling), so a single long A then B measurement mostly
+    measures the weather; tight pairing + median cancels the bursts.
+    Tiny geometry on purpose: the quantity under test is the
+    host<->device dispatch seam, not model compute, so CPU numbers are
+    meaningful and this is the one decode-throughput probe a chipless
+    container can produce real deltas for."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST.scaled(
+        name="micro-dispatch", num_layers=1, hidden_size=32,
+        intermediate_size=64, num_heads=2, num_kv_heads=1, head_dim=16,
+        vocab_size=256, max_context=512,
+    )
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    chunk, max_tokens, slots, pairs = 16, 256, 8, 9
+
+    def wave(batcher):
+        handles = [
+            batcher.submit(Request(prompt_ids=[3 + i, 17, 91],
+                                   max_tokens=max_tokens, temperature=0.0))
+            for i in range(slots)
+        ]
+        t0 = time.time()
+        out = [h.tokens() for h in handles]
+        return sum(len(t) for t in out) / (time.time() - t0), out
+
+    arms = []  # (engine, batcher) for pipeline off, on
+    try:
+        for pipeline in (False, True):
+            eng = TPUEngine(cfg, params, num_slots=slots, max_context=512,
+                            cache_dtype=jnp.float32)
+            eng.warmup(step_sizes=(2, chunk), prefill_chunk=0)
+            batcher = ContinuousBatcher(
+                eng, chunk_steps=chunk, admit_chunk_steps=2,
+                pipeline=pipeline,
+            )
+            wave(batcher)  # steady state before any measured pair
+            arms.append((eng, batcher))
+        ratios, identical = [], True
+        tps = {False: [], True: []}
+        for pair in range(pairs):
+            # alternate which arm goes first so slow drifts in container
+            # CPU availability cancel within the pair set
+            order = (0, 1) if pair % 2 == 0 else (1, 0)
+            got = {}
+            for idx in order:
+                got[idx] = wave(arms[idx][1])
+            identical = identical and got[0][1] == got[1][1]
+            ratios.append(got[1][0] / max(got[0][0], 1e-9))
+            tps[False].append(got[0][0])
+            tps[True].append(got[1][0])
+        gaps = {
+            p: b.host_gap_seconds / max(b.decode_dispatches, 1) * 1e3
+            for p, (_, b) in zip((False, True), arms)
+        }
+        flushes = arms[1][1].flushes
+    finally:
+        for eng, batcher in arms:
+            batcher.shutdown()
+            eng.close()
+    ratios_sorted = sorted(ratios)
+    speedup = statistics.median(ratios)
+    q25 = ratios_sorted[len(ratios) // 4]
+    q75 = ratios_sorted[-1 - len(ratios) // 4]
+    log(f"[dispatch] pipeline off med {statistics.median(tps[False]):.0f} "
+        f"tok/s (gap {gaps[False]:.2f} ms) -> on med "
+        f"{statistics.median(tps[True]):.0f} tok/s (gap {gaps[True]:.2f} "
+        f"ms); per-pair ratios {['%.2f' % r for r in ratios]}, median "
+        f"{speedup:.2f}x (IQR {q25:.2f}-{q75:.2f}), identical={identical}")
+    return {
+        "metric": "pipelined decode loop A/B, continuous batcher "
+                  f"(batch {slots}, {chunk}-step dispatches, {pairs} "
+                  "order-alternated paired waves, micro geometry)",
+        "value": round(speedup, 3),
+        "unit": "x tok/s (pipeline on vs off, median of paired waves)",
+        "vs_baseline": round(speedup, 3),
+        "tps_pipeline_off": round(statistics.median(tps[False]), 1),
+        "tps_pipeline_on": round(statistics.median(tps[True]), 1),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "ratio_iqr": [round(q25, 3), round(q75, 3)],
+        "host_gap_ms_off": round(gaps[False], 3),
+        "host_gap_ms_on": round(gaps[True], 3),
+        "pipeline_flushes": int(flushes),
+        "tokens_identical": bool(identical),
+        # this container: 2 shared cores, XLA's compute threads saturate
+        # both, and the scheduler's host phase is ~2 ms against 20+ ms
+        # dispatches — the structural ceiling for overlap here is ~10%.
+        # The mechanism (identical streams, dispatch worker overlap) is
+        # what this probe regression-guards; absolute gains need the TPU
+        # (device compute does not contend with the host there).
+        "cpu_cores": os.cpu_count(),
+    }
+
+
 def bench_moe_gather():
     """Gathered-expert MoE decode A/B on the real chip: a ~2.3B-param
     MoE geometry (32 experts, top-4 — qwen3-moe-style, scaled to fit one
@@ -1129,8 +1242,8 @@ def main() -> int:
         configs = configs[:1]
     extra = [] if args.skip_mistral else [bench_mixed_tier, bench_spec_decode]
     extra.extend([
-        bench_paged_kv, bench_host_tier, bench_agent_ttft, bench_moe_gather,
-        bench_int8_kv_ragged_ab, bench_orchestrator_e2e,
+        bench_paged_kv, bench_host_tier, bench_dispatch, bench_agent_ttft,
+        bench_moe_gather, bench_int8_kv_ragged_ab, bench_orchestrator_e2e,
     ])
     if args.fast:
         extra = []

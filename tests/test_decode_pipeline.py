@@ -1,0 +1,327 @@
+"""Pipelined decode loop + dispatch-free AOT warmup (ISSUE 6).
+
+Three guarantees under test:
+  * token identity: the depth-2 pipelined batcher (AIOS_TPU_DECODE_PIPELINE)
+    emits byte-for-byte the streams the sync loop emits — greedy AND
+    sampled with a fixed seed — including across retirement boundaries,
+    ``force_pending_token`` (grammar-constrained admission), and
+    chunked-prefill interleaving, where the pipeline must flush;
+  * no compile after warmup: ``engine.warmup()`` AOT-compiles every graph
+    the serving path can hit, so a post-warmup sweep across every prefill
+    bucket, both chunked-admission paths, every decode chunk size, the
+    masked step, and the prefix-hit path moves ``engine.stats()``'s
+    compile counters by exactly zero;
+  * the unified dynamic-step graph (AIOS_TPU_UNIFIED_STEP) is greedy-
+    identical to the per-size scan graphs and serves unwarmed chunk sizes
+    without compiling.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu.engine import model as M
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.engine.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_engine(params, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return TPUEngine(TINY_TEST, params, **kw)
+
+
+def run_batch(params, pipeline, reqs, *, engine_kw=None, batcher_kw=None,
+              warm=True):
+    """One engine+batcher lifecycle: submit ``reqs`` (dicts) up front,
+    drain every stream, return (per-request token lists, batcher, engine
+    stats)."""
+    eng = make_engine(params, **(engine_kw or {}))
+    if warm:
+        eng.warmup(step_sizes=(2, 4), prefill_chunk=32)
+    kw = dict(chunk_steps=4, admit_chunk_steps=2, pipeline=pipeline)
+    kw.update(batcher_kw or {})
+    b = ContinuousBatcher(eng, **kw)
+    try:
+        handles = [b.submit(Request(**r)) for r in reqs]
+        outs = [h.tokens() for h in handles]
+        stats = dict(eng.stats())
+        stats["flushes"] = b.flushes
+        stats["dispatches"] = b.decode_dispatches
+        stats["evictions"] = b.pool_evictions
+        stats["aborted"] = [h.abort_reason for h in handles]
+        return outs, stats
+    finally:
+        b.shutdown()
+        eng.close()
+
+
+def test_pipeline_token_identical_greedy(params):
+    """Same streams pipeline-on vs -off at temperature 0, with staggered
+    max_tokens so requests retire at different dispatch boundaries and a
+    stop token that fires mid-dispatch."""
+    reqs = [
+        dict(prompt_ids=[3 + i, 17, 91, 4 + i], max_tokens=18 + 5 * i,
+             temperature=0.0)
+        for i in range(4)
+    ]
+    off, s_off = run_batch(params, False, reqs)
+    # make one request stop early on a token the free run actually emits
+    reqs[1]["stop_ids"] = (off[1][4],)
+    off, s_off = run_batch(params, False, reqs)
+    on, s_on = run_batch(params, True, reqs)
+    assert on == off
+    assert len(off[1]) <= 5 + 1  # the stop actually fired
+    # the pipelined run really pipelined: dispatches were issued ahead
+    assert s_on["dispatches"] > 0
+
+
+def test_pipeline_token_identical_sampled(params):
+    """Fixed engine seed, temperature > 0: the pipelined dispatch chain
+    consumes the SAME per-dispatch key splits, so sampled streams match
+    token-for-token."""
+    reqs = [
+        dict(prompt_ids=[7 + i, 2, 55], max_tokens=21 + 4 * i,
+             temperature=0.85, top_p=0.9)
+        for i in range(4)
+    ]
+    off, _ = run_batch(params, False, reqs)
+    on, _ = run_batch(params, True, reqs)
+    assert on == off
+    assert any(len(set(t)) > 1 for t in on)  # actually sampled something
+
+
+def test_pipeline_flushes_idle_after_retirement(params):
+    """When the whole batch retires, the next tick flushes (or shutdown
+    drops) the speculatively-issued dispatch; the stream itself is exactly
+    max_tokens long."""
+    reqs = [dict(prompt_ids=[5, 6, 7], max_tokens=13, temperature=0.0)]
+    off, _ = run_batch(params, False, reqs)
+    on, stats = run_batch(params, True, reqs)
+    assert on == off and len(on[0]) == 13
+
+
+def test_pipeline_constrained_flush_and_force_pending_token(params):
+    """A json_mode request admitted mid-stream forces its pending opener
+    (force_pending_token) and rides 1-step masked dispatches — the
+    pipeline must drain first (cause=constrained), and both the
+    constrained and the co-resident unconstrained stream stay correct."""
+    tok = ByteTokenizer()
+    eng = make_engine(params)
+    eng.warmup(step_sizes=(2, 4), prefill_chunk=32, masked_step=True)
+    b = ContinuousBatcher(eng, chunk_steps=4, admit_chunk_steps=2,
+                          pipeline=True, tokenizer=tok)
+    try:
+        plain = b.submit(Request(prompt_ids=tok.encode("plain"),
+                                 max_tokens=60, temperature=0.0))
+        # consume a few tokens FIRST: after >= 1 plain decode tick the
+        # pipeline holds an in-flight dispatch (and keeps holding one,
+        # tick over tick) — so the constrained admission below MUST
+        # drain it, deterministically
+        it = iter(plain)
+        t_plain = [next(it) for _ in range(4)]
+        constrained = b.submit(Request(
+            prompt_ids=tok.encode("emit json"), max_tokens=40,
+            temperature=0.9, stop_ids=(tok.eos_id,), json_mode=True,
+        ))
+        t_plain += list(it)
+        t_json = constrained.tokens()
+        parsed = json.loads(tok.decode(t_json))
+        assert isinstance(parsed, dict)
+        assert len(t_plain) == 60
+        # the constrained tick drained the pipeline at least once while
+        # the plain stream was mid-flight
+        assert b.flushes >= 1
+    finally:
+        b.shutdown()
+        eng.close()
+
+
+def test_pipeline_chunked_prefill_interleave_identical(params):
+    """A long prompt admitting chunk-by-chunk between pipelined decode
+    dispatches: streams match the sync loop exactly (the chunk writes and
+    the in-flight decode order through the donated state chain)."""
+    long_prompt = (np.arange(1, 90) % 250 + 1).tolist()  # > prefill_chunk 32
+    reqs = [
+        dict(prompt_ids=[9, 8, 7], max_tokens=24, temperature=0.0),
+        dict(prompt_ids=long_prompt, max_tokens=12, temperature=0.0),
+        dict(prompt_ids=[41, 2], max_tokens=16, temperature=0.0),
+    ]
+    kw = dict(batcher_kw=dict(prefill_chunk=32))
+    off, _ = run_batch(params, False, reqs, **kw)
+    on, _ = run_batch(params, True, reqs, **kw)
+    assert on == off
+    assert len(on[1]) == 12
+
+
+def test_pipeline_pool_eviction_flush(params):
+    """Pool exhaustion mid-decode with a dispatch in flight: the eviction
+    path flushes first (the victim keeps every token it produced before
+    the abort), the survivor completes, and the engine state stays
+    coherent."""
+    # 4 usable pages (128 rows): both streams fit at admission (1 page
+    # each) but cross their 3rd-page boundary together mid-decode — 6
+    # pages wanted, 4 exist — so the dispatch path must evict the
+    # priority-0 stream while the priority-1 survivor (80 rows = 3 pages
+    # peak) still completes
+    reqs = [
+        dict(prompt_ids=list(range(1, 31)), max_tokens=50, temperature=0.0,
+             priority=1),
+        dict(prompt_ids=list(range(40, 70)), max_tokens=80, temperature=0.0),
+    ]
+    outs, stats = run_batch(
+        params, True, reqs,
+        engine_kw=dict(num_slots=2, paged_pool_rows=128, page_size=32,
+                       prefix_cache=False),
+    )
+    assert stats["evictions"] >= 1
+    aborted = [r for r in stats["aborted"] if r]
+    assert aborted and "evicted" in aborted[0]
+    # the survivor (higher priority) ran to completion
+    survivor = [o for o, r in zip(outs, stats["aborted"]) if not r]
+    assert survivor and len(survivor[0]) > 0
+
+
+def test_no_compile_after_warmup_serving_sweep(params):
+    """The AOT readiness gate covers the WHOLE serving surface: walking
+    every prefill bucket, the chunked-admission path, the prefix-hit
+    path, every warmed decode chunk size, and the grammar-masked step
+    moves the engine's compile counters by exactly zero."""
+    eng = TPUEngine(
+        TINY_TEST.scaled(max_context=512), params, num_slots=2,
+        max_context=512, cache_dtype=jnp.float32,
+        paged_pool_rows=512, page_size=32, prefix_host_bytes=32 << 20,
+    )
+    try:
+        eng.warmup(step_sizes=(1, 2, 8, 16), masked_step=True)
+        before = eng.stats()["xla_compiles"]
+        rng = np.random.default_rng(7)
+        # every monolithic prefill bucket the pool can back
+        for b in eng.buckets:
+            n = b // 2 + 1
+            if eng.allocator.blocks_for(n) > eng.allocator.capacity_blocks():
+                continue
+            prompt = [int(t) for t in rng.integers(1, 500, n)]
+            eng.prefill(0, prompt, temperature=0.0)
+            eng.step(1)
+            eng.release(0)
+        # chunked admission (mid + final chunk graphs)
+        long_prompt = [int(t) for t in rng.integers(1, 500, 420)]
+        pc = eng.start_chunked_prefill(0, long_prompt, chunk=eng._prefix_chunk)
+        while pc.step() is None:
+            pass
+        # both batcher chunk sizes + the masked step + a forced token
+        for n in (1, 2, 8, 16):
+            eng.step(n)
+        eng.force_pending_token(0, 3)
+        eng.step_masked(np.zeros((2, TINY_TEST.vocab_size), np.float32))
+        eng.release(0)
+        # prefix-HIT path: resubmit -> history backfill + tail chunks
+        eng.prefill(0, long_prompt + [5], temperature=0.0)
+        eng.release(0)
+        assert eng.stats()["xla_compiles"] == before, (
+            "serving sweep compiled a graph warmup should have covered"
+        )
+    finally:
+        eng.close()
+
+
+def test_warmup_covers_host_tier_restore(params):
+    """Spill -> restore after warmup compiles nothing: the bucketed
+    restore scatters were AOT-built behind the readiness gate."""
+    eng = TPUEngine(
+        TINY_TEST.scaled(max_context=512), params, num_slots=2,
+        max_context=512, cache_dtype=jnp.float32,
+        paged_pool_rows=512, page_size=32, prefix_host_bytes=32 << 20,
+    )
+    try:
+        eng.warmup(step_sizes=(1,))
+        before = eng.stats()["xla_compiles"]
+        rng = np.random.default_rng(11)
+        preamble = [int(t) for t in rng.integers(1, 500, 321)]
+        eng.prefill(0, preamble, temperature=0.0)
+        eng.release(0)
+        pressure = [int(t) for t in rng.integers(1, 500, 480)]
+        eng.prefill(0, pressure, temperature=0.0)  # reclaim -> spill
+        eng.release(0)
+        deadline = __import__("time").time() + 10
+        while eng.host_store.spills < 2 and __import__("time").time() < deadline:
+            __import__("time").sleep(0.02)
+        eng.prefill(0, preamble, temperature=0.0)  # host-tier restore
+        eng.release(0)
+        assert eng.stats().get("host_tier_restores", 0) >= 1
+        assert eng.stats()["xla_compiles"] == before
+    finally:
+        eng.close()
+
+
+def test_unified_step_greedy_identical_one_graph(params):
+    """AIOS_TPU_UNIFIED_STEP mode: one dynamic-n graph serves every chunk
+    size (warmed or not) with zero extra compiles, and greedy output
+    matches the per-size scan graphs token-for-token."""
+    uni = make_engine(params, unified_step=True)
+    ref = make_engine(params)
+    try:
+        uni.warmup(step_sizes=(1, 2, 8, 16), prefill_chunk=0)
+        step_graphs = [k for k in uni._step_fns if isinstance(k, tuple)]
+        assert step_graphs == [("uni", 16)]
+        before = uni.stats()["xla_compiles"]
+        prompt = [3, 17, 91, 4, 55, 8]
+        g_uni = [uni.prefill(0, prompt, temperature=0.0)]
+        g_ref = [ref.prefill(0, prompt, temperature=0.0)]
+        for n in (1, 2, 8, 5, 16, 3):  # 5 and 3 were never warmed
+            g_uni += [int(t) for t in uni.step(n)[:, 0]]
+            g_ref += [int(t) for t in ref.step(n)[:, 0]]
+        assert g_uni == g_ref
+        assert uni.stats()["xla_compiles"] == before
+    finally:
+        uni.close()
+        ref.close()
+
+
+def test_batcher_attach_compiles_missing_sizes_without_dispatch(params):
+    """A batcher with non-default chunk sizes attaching to a warmed
+    engine AOT-compiles its sizes — engine state must not move (the old
+    path dispatched real steps to compile them)."""
+    eng = make_engine(params)
+    eng.warmup(step_sizes=(16,), prefill_chunk=0)
+    try:
+        b = ContinuousBatcher(eng, chunk_steps=5, admit_chunk_steps=3)
+        try:
+            assert {3, 5} <= set(eng._step_fns)
+            assert eng.decode_steps == 0
+        finally:
+            b.shutdown()
+    finally:
+        eng.close()
+
+
+def test_pending_decode_lengths_snapshot(params):
+    """step_async dispatches run FIFO on the engine's dispatch worker,
+    and each pending handle carries the post-dispatch lengths of ITS
+    dispatch — later dispatches must not leak into the snapshot (the
+    out-of-cache retirement anchor)."""
+    eng = make_engine(params)
+    try:
+        eng.prefill(0, [1, 2, 3], temperature=0.0)
+        p1 = eng.step_async(2)
+        p2 = eng.step_async(4)
+        assert p1.wait().shape == (2, 4)
+        assert p2.wait().shape == (4, 4)
+        assert p1.lengths[0] == 5 and p2.lengths[0] == 9
+        assert eng.slot_length(0) == 9
+        # the fence used by the batcher's tick ordering
+        p2.wait_started()
+    finally:
+        eng.close()

@@ -138,6 +138,52 @@ def test_prefix_host_labels_are_model_only():
             )
 
 
+# -- the decode dispatch family (pipelined batcher, engine/batching.py) ----
+
+ENGINE_DISPATCH_EXPECTED = {
+    "aios_tpu_engine_dispatch_host_gap_seconds": "histogram",
+    "aios_tpu_engine_dispatch_inflight_total": "gauge",
+    "aios_tpu_engine_dispatch_flushes_total": "counter",
+}
+
+
+def test_engine_dispatch_family_complete_and_typed():
+    """The decode-dispatch instruments the ISSUE 6 catalog promises
+    exist, with the promised kinds — and any NEW
+    aios_tpu_engine_dispatch_* metric must be added here (and to
+    docs/ENGINE_PERF.md + OBSERVABILITY.md) so the family stays
+    reviewed. The kind map doubles as the unsuffixed-unit gate for this
+    PR's additions: a dispatch metric not ending in an approved unit
+    suffix fails test_metric_names_carry_a_unit_suffix AND this
+    equality."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_engine_dispatch_")
+    }
+    assert family == ENGINE_DISPATCH_EXPECTED
+    for name in family:
+        assert name.endswith(UNIT_SUFFIXES), (
+            f"{name}: dispatch metrics carry a unit suffix like every "
+            f"other family"
+        )
+
+
+def test_engine_dispatch_flush_causes_bounded():
+    """Flush causes are a fixed enum (see ContinuousBatcher
+    _flush_pending call sites) — the label must never grow a per-request
+    or per-slot dimension."""
+    import inspect
+
+    from aios_tpu.engine import batching
+
+    causes = set(
+        re.findall(r'_flush_pending\("([a-z_]+)"\)',
+                   inspect.getsource(batching))
+    )
+    assert causes, "no _flush_pending call sites found"
+    assert causes <= {"constrained", "spec", "evict", "idle"}
+
+
 def test_serving_label_conventions():
     """Serving labels stay low-cardinality by construction: routing
     reasons and shed causes are fixed enums (see serving/pool.py); only
