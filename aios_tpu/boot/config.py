@@ -63,6 +63,14 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             # dynamic-n XLA graph (greedy-identical; opt-in). "" = off.
             "decode_pipeline": "",
             "unified_step": "",
+            # grammar jump-ahead for constrained/structured decoding
+            # (multi-token forced runs in one dispatch; default ON) and
+            # the radix-tree prefix index (default ON) — tri-state
+            # escape hatches; spec_min_accept floors the speculative
+            # EWMA acceptance ratio (0/"" = never auto-disable).
+            "jump_ahead": "",
+            "prefix_radix": "",
+            "spec_min_accept": "",
             "json_mode": "",         # "force" = reference json_object parity
             "guided_toolcalls": False,  # schema-guided reasoning replies
             # multi-chip serving mesh, e.g. "tp=4" (BASELINE config 4:
@@ -215,6 +223,8 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
     for cfg_key, env_key in (
         ("decode_pipeline", "AIOS_TPU_DECODE_PIPELINE"),
         ("unified_step", "AIOS_TPU_UNIFIED_STEP"),
+        ("jump_ahead", "AIOS_TPU_JUMP_AHEAD"),
+        ("prefix_radix", "AIOS_TPU_PREFIX_RADIX"),
     ):
         raw = m.get(cfg_key, "")
         if raw in ("", None):
@@ -238,6 +248,9 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         ("tenant_burst_tokens", "AIOS_TPU_TENANT_BURST_TOKENS", False),
         ("max_queue", "AIOS_TPU_MAX_QUEUE", True),
         ("assumed_tps", "AIOS_TPU_ASSUMED_TPS", False),
+        # an explicit 0 forwards (it means "never auto-disable",
+        # overriding a ModelConfig.spec_min_accept default)
+        ("spec_min_accept", "AIOS_TPU_SPEC_MIN_ACCEPT", True),
     ):
         raw = m.get(cfg_key, "")
         if raw in ("", None):

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
@@ -26,7 +27,11 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .engine import ChunkedPrefill, PendingDecode, TPUEngine, _env_flag
+import numpy as np
+
+from .engine import (
+    JUMP_BUCKETS, ChunkedPrefill, PendingDecode, TPUEngine, _env_flag,
+)
 from .paged import PoolExhausted
 from ..obs import instruments as obs
 
@@ -46,6 +51,13 @@ _BATCHERS_BY_MODEL: Dict[str, object] = {}
 # waiting, bounding starvation under sustained higher-priority traffic
 # (a priority-0 request outranks a fresh strategic (3) after ~15 s).
 PRIORITY_AGING_SECS = 5.0
+
+# How long an EWMA-collapse keeps speculation off before one fresh probe
+# dispatch re-measures (the workload may have turned repetitive again).
+SPEC_REPROBE_SECS = 10.0
+
+# EWMA smoothing for the per-dispatch draft-acceptance ratio.
+SPEC_EWMA_ALPHA = 0.3
 
 
 @dataclass
@@ -161,6 +173,8 @@ class ContinuousBatcher:
         spec_ngram: int = 3,
         tokenizer=None,  # enables json_mode requests (mask table source)
         pipeline: Optional[bool] = None,  # depth-2 pipelined decode loop
+        jump_ahead: Optional[bool] = None,  # grammar jump-ahead decoding
+        spec_min_accept: Optional[float] = None,  # spec auto-disable floor
     ) -> None:
         self.engine = engine
         # Pipelined decode (AIOS_TPU_DECODE_PIPELINE /
@@ -214,6 +228,50 @@ class ContinuousBatcher:
         self.speculative = speculative
         self.spec_draft_len = spec_draft_len
         self.spec_ngram = spec_ngram
+        # Spec auto-disable (AIOS_TPU_SPEC_MIN_ACCEPT /
+        # ModelConfig.spec_min_accept): when the EWMA draft-acceptance
+        # ratio of this batcher's spec dispatches collapses below the
+        # floor, speculation suspends — decode falls back to the
+        # plain/pipelined path, whose per-dispatch cost the failed
+        # drafts were inflating — and one probe dispatch re-measures
+        # after SPEC_REPROBE_SECS. 0 = never auto-disable.
+        if spec_min_accept is None:
+            raw = os.environ.get("AIOS_TPU_SPEC_MIN_ACCEPT", "").strip()
+            if raw:
+                try:
+                    spec_min_accept = float(raw)
+                    if not 0.0 <= spec_min_accept <= 1.0:
+                        raise ValueError("must be in [0, 1]")
+                except ValueError as exc:
+                    log.warning(
+                        "AIOS_TPU_SPEC_MIN_ACCEPT=%r ignored (%s)", raw, exc
+                    )
+                    spec_min_accept = None
+        if spec_min_accept is None:
+            spec_min_accept = float(
+                getattr(engine.cfg, "spec_min_accept", 0.0)
+            )
+        self.spec_min_accept = spec_min_accept
+        self.spec_ewma: Optional[float] = None  # None until first measure
+        self._spec_off_until = 0.0
+        self.spec_autodisables = 0
+        # Grammar jump-ahead (AIOS_TPU_JUMP_AHEAD /
+        # ModelConfig.jump_ahead, default ON): chains of grammar-FORCED
+        # tokens (singleton masks — schema key literals, ':', ',',
+        # closers) emit host-side and append their KV in ONE multi-token
+        # verify dispatch instead of one masked dispatch each. Greedy
+        # streams are token-identical to the per-step path (forced
+        # tokens of sampled streams too; the sampled remainder draws a
+        # shifted key chain, the unified_step caveat). Unsupported —
+        # like speculative verify — on a dp-replicated page pool.
+        if jump_ahead is None:
+            jump_ahead = _env_flag("AIOS_TPU_JUMP_AHEAD")
+        if jump_ahead is None:
+            jump_ahead = bool(getattr(engine.cfg, "jump_ahead", True))
+        self.jump_ahead = bool(jump_ahead) and getattr(
+            engine, "spec_supported", True
+        )
+        self.jump_max = JUMP_BUCKETS[-1]
         # prompts longer than this admit incrementally (one cache-writing
         # chunk per scheduler pass) so a long admission never stalls decode
         # for the active slots; 0 disables. Defaults to the engine's
@@ -271,6 +329,16 @@ class ContinuousBatcher:
                     engine.compile_spec_fn(
                         n, self.spec_draft_len, self.spec_ngram
                     )
+            if self.jump_ahead and "masked" in engine._step_fns:
+                # constrained serving was declared at warmup (the masked
+                # graph is the same signal json-mode deployments use):
+                # make sure every run-length bucket the constrained tick
+                # can dispatch is compiled too (no-ops when warmup's
+                # jump_sizes already covered them). Deployments that
+                # never warmed the masked step keep the lazy behavior —
+                # their first constrained request compiles both, visibly.
+                for k in JUMP_BUCKETS:
+                    engine.compile_jump_fn(k)
         # Metric children resolved ONCE (labels() is a locked dict lookup
         # — fine per request, too slow per decoded token); the queue-depth
         # gauge pulls live state at scrape time through a weakref so a
@@ -300,6 +368,16 @@ class ContinuousBatcher:
         peers.add(self)
         obs.ENGINE_DISPATCH_INFLIGHT.labels(model=model_name).set_function(
             lambda: float(sum(1 for b in peers if b._pending is not None))
+        )
+
+        def _acceptance() -> float:
+            vals = [
+                b.spec_ewma for b in peers if b.spec_ewma is not None
+            ]
+            return float(sum(vals) / len(vals)) if vals else 0.0
+
+        obs.SPEC_ACCEPTANCE.labels(model=model_name).set_function(
+            _acceptance
         )
         # tokens/sec gauge state: emitted tokens over a ~1 s window,
         # refreshed from the scheduler loop (decays to 0 when idle).
@@ -344,9 +422,15 @@ class ContinuousBatcher:
             if self._json_masks is None:
                 from . import jsonmode
 
+                # compact=True: generation never emits structural
+                # whitespace (canonical compact JSON, still valid), so
+                # grammar-forced positions are SINGLETON states that
+                # jump-ahead collapses into multi-token runs — and the
+                # budget closing walk can't dither on whitespace
                 self._json_masks = jsonmode.JsonMaskCache(
                     self._token_bytes(),
                     getattr(self.tokenizer, "eos_id", None),
+                    compact=True,
                 )
             return self._json_masks
 
@@ -374,6 +458,7 @@ class ContinuousBatcher:
                 getattr(self.tokenizer, "eos_id", None),
                 schema,
                 byte_matrix=self._byte_matrix,
+                compact=True,  # same rationale as the json_mode cache
             )
             if self._byte_matrix is None:
                 self._byte_matrix = (cache._byte_mat, cache._byte_lens)
@@ -932,6 +1017,117 @@ class ContinuousBatcher:
             except Exception as exc:  # noqa: BLE001
                 self._abort_all(exc)
 
+    # -- speculative auto-disable (EWMA acceptance floor) -------------------
+
+    def _spec_active(self) -> bool:
+        """Whether the next decode tick may dispatch speculatively. An
+        EWMA-collapse below ``spec_min_accept`` suspends speculation for
+        SPEC_REPROBE_SECS (plain/pipelined decode serves meanwhile — the
+        failed drafts were pure per-dispatch overhead); when the window
+        expires the EWMA resets so ONE probe dispatch re-decides on fresh
+        evidence instead of dragging the collapsed history along."""
+        if not self._spec_off_until:
+            return True
+        if time.monotonic() < self._spec_off_until:
+            return False
+        self._spec_off_until = 0.0
+        self.spec_ewma = None  # re-probe: fresh measurement decides
+        return True
+
+    def _spec_measure(self, counts, consumed: Dict[int, int]) -> None:
+        """Fold one spec dispatch's acceptance into the EWMA and suspend
+        speculation when it collapses below the floor. ``counts`` is the
+        dispatch's [rounds, num_slots] emitted-token matrix; ``consumed``
+        maps slot -> rounds whose tokens were actually EMITTED (each
+        emits 1 + accepted-drafts). Rounds past a request's mid-dispatch
+        retirement are excluded — their drafts score a continuation that
+        is never served, and folding them in would suspend speculation on
+        workloads whose served tokens accept perfectly well."""
+        possible = sum(consumed.values()) * self.spec_draft_len
+        if not possible:
+            return
+        accepted = sum(
+            float(counts[:r, s].sum()) - r for s, r in consumed.items()
+        )
+        ratio = max(accepted, 0.0) / possible
+        self.spec_ewma = (
+            ratio if self.spec_ewma is None
+            else (1 - SPEC_EWMA_ALPHA) * self.spec_ewma
+            + SPEC_EWMA_ALPHA * ratio
+        )
+        if self.spec_min_accept > 0 and self.spec_ewma < self.spec_min_accept:
+            self._spec_off_until = time.monotonic() + SPEC_REPROBE_SECS
+            self.spec_autodisables += 1
+            log.info(
+                "%s: speculation suspended (EWMA acceptance %.3f < "
+                "floor %.3f); re-probing in %.0fs",
+                self.engine.cfg.name, self.spec_ewma,
+                self.spec_min_accept, SPEC_REPROBE_SECS,
+            )
+
+    # -- grammar jump-ahead (compressed-FSM run collapse) -------------------
+
+    def _jump_tick(self, constrained) -> bool:
+        """Collapse chains of grammar-FORCED tokens into one multi-token
+        dispatch (engine.jump_step) instead of one masked dispatch each.
+
+        Each constrained slot's automaton is probed for a forced run —
+        states whose effective mask admits exactly ONE token (schema key
+        literals, '":', '",', closing braces; see
+        JsonConstraint.forced_run). Runs of >= 2 tokens pay for a jump:
+        the dispatch appends their K/V through the verify machinery with
+        acceptance pinned to all-accept, and the tokens emit host-side
+        (they ARE the only tokens any sampler could produce, so streams
+        are identical to the per-step path). Slots without a run — and
+        unconstrained co-residents — do not advance this dispatch; the
+        next tick serves them with the usual masked step, so a mixed
+        batch pays at most one extra tick per run while the run itself
+        collapses from len(run) dispatches to one.
+
+        Returns True when a jump dispatch was issued (the tick is done).
+        """
+        runs: Dict[int, List[int]] = {}
+        for s_, live in constrained:
+            c = live.constraint
+            if c is None or getattr(c, "failed", False):
+                continue
+            rem = live.req.max_tokens - live.produced
+            # the verify-write contract: post-run length <= C-2
+            room = self.engine.max_context - 2 - self.engine.slot_length(s_)
+            cap = min(self.jump_max, rem, room)
+            if cap < 2:
+                continue
+            run = c.forced_run(cap, remaining=rem,
+                               stop_ids=live.req.stop_ids)
+            if len(run) >= 2:
+                runs[s_] = run
+        if not runs:
+            return False
+        k = max(len(r) for r in runs.values())
+        forced = np.zeros((self.engine.num_slots, k), np.int32)
+        counts = np.zeros((self.engine.num_slots,), np.int32)
+        for s_, run in runs.items():
+            forced[s_, : len(run)] = run
+            counts[s_] = len(run)
+        try:
+            self._note_dispatch()
+            self.engine.jump_step(forced, counts)
+            self._gap_mark = time.monotonic()
+        except PoolExhausted as e:
+            self._evict_longest(e.replica)  # retry next tick
+            return True
+        by_slot = dict(constrained)
+        for s_ in sorted(runs):
+            live = by_slot[s_]
+            if live.done:
+                continue
+            for tok in runs[s_]:
+                live.constraint.advance(tok)
+                self._emit(live, tok)
+                if live.done:
+                    break
+        return True
+
     def _tick(self) -> None:
         now = time.monotonic()
         if now - self._rate_t0 >= 1.0:
@@ -978,6 +1174,8 @@ class ContinuousBatcher:
             # unconstrained co-resident slots cost nothing (no per-slot
             # row stack, no per-step PCIe traffic).
             self._flush_pending("constrained")
+            if self.jump_ahead and self._jump_tick(constrained):
+                return
             import jax.numpy as jnp
 
             by_slot = dict(constrained)
@@ -1026,7 +1224,7 @@ class ContinuousBatcher:
         with self._qlock:
             anyone_waiting = bool(self._waiting) or self._prefilling is not None
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
-        if self.speculative:
+        if self.speculative and self._spec_active():
             # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
             # run in order; _emit retires requests mid-dispatch as usual.
             # Speculative dispatches consume their own output synchronously
@@ -1042,14 +1240,17 @@ class ContinuousBatcher:
             except PoolExhausted as e:
                 self._evict_longest(e.replica)  # retry next tick
                 return
+            consumed: Dict[int, int] = {}
             for r in range(tokens.shape[0]):
                 for slot, live in list(slots.items()):
                     if live.done:
                         continue
+                    consumed[slot] = r + 1  # this round's tokens are served
                     for j in range(int(counts[r, slot])):
                         self._emit(live, int(tokens[r, slot, j]))
                         if live.done:
                             break
+            self._spec_measure(counts, consumed)
             return
         if self.pipeline:
             # depth-2 double buffer: hand dispatch N+1 to the engine's

@@ -55,6 +55,23 @@ class ModelConfig:
     # scan graph per size. Greedy-identical; sampled sequences draw from a
     # different key fanout. AIOS_TPU_UNIFIED_STEP overrides at load time.
     unified_step: bool = False
+    # grammar jump-ahead for constrained decoding (engine/batching.py
+    # _jump_tick): chains of grammar-FORCED tokens (singleton masks —
+    # schema key literals, '":', '",', closers) emit host-side and append
+    # their KV in ONE multi-token dispatch instead of one masked dispatch
+    # each. Greedy-identical to the per-step path; AIOS_TPU_JUMP_AHEAD
+    # overrides at load time (docs/ENGINE_PERF.md).
+    jump_ahead: bool = True
+    # auto-disable n-gram speculation per batcher when the EWMA draft-
+    # acceptance ratio collapses below this floor (plain/pipelined decode
+    # serves meanwhile; one probe dispatch re-measures periodically).
+    # 0 = never auto-disable. AIOS_TPU_SPEC_MIN_ACCEPT overrides.
+    spec_min_accept: float = 0.0
+    # radix-tree prefix index (engine/paged.py RadixPrefixIndex): cross-
+    # request prefix sharing by construction with leaf-LRU eviction and
+    # partial-node overlap credit for the router. False = the legacy flat
+    # hash-chain map (escape hatch). AIOS_TPU_PREFIX_RADIX overrides.
+    prefix_radix: bool = True
 
     @property
     def moe(self) -> bool:

@@ -42,10 +42,26 @@ log = logging.getLogger("aios.engine")
 
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
+# Run-length buckets for the grammar jump-ahead graphs (jump_step): a
+# forced run of K tokens dispatches through the smallest bucket >= K, so
+# warmup compiles len(JUMP_BUCKETS) graphs and serving never compiles.
+# Two buckets on purpose: padding a short run up to the bucket is nearly
+# free (the verify dispatch is weight-bandwidth-bound), while every extra
+# bucket is another graph in every constrained deployment's warmup gate.
+# Bounded by spec.HISTORY_PAD - 2 (the post-dispatch history scatter must
+# stay inside the pad margin, same bound as speculative draft_len).
+JUMP_BUCKETS = (4, 16)
+assert JUMP_BUCKETS[-1] <= spec.HISTORY_PAD - 2
+
 # Live HostPageStores per model name: replica engines share the (model,)
 # label on the aios_tpu_prefix_host_* gauges, so the scrape callbacks sum
 # over this set instead of reporting whichever replica registered last.
 _HOST_STORES_BY_MODEL: Dict[str, object] = {}
+
+# Live engines per model name, for the same last-writer-wins reason: the
+# aios_tpu_engine_jump_ahead_* and aios_tpu_spec_* gauges sum over every
+# replica engine instead of reporting whichever registered last.
+_ENGINES_BY_MODEL: Dict[str, object] = {}
 
 
 def _cpu_device():
@@ -267,6 +283,7 @@ class TPUEngine:
         seq_sharded_cache: bool = False,  # shard KV context axis over sp
         track_history: bool = True,  # device-side token history (spec.py)
         unified_step: Optional[bool] = None,  # one dynamic-n decode graph
+        prefix_radix: Optional[bool] = None,  # radix-tree prefix index
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -561,7 +578,20 @@ class TPUEngine:
             if prefix_cache is None:
                 prefix_cache = True
             if prefix_cache and self._prefix_chunk is not None:
-                self.prefix_index = paged.PrefixIndex(
+                # radix tree by default (cross-request sharing by
+                # construction, leaf-LRU eviction, partial-node overlap
+                # credit for the router); AIOS_TPU_PREFIX_RADIX=0 /
+                # ModelConfig.prefix_radix=False is the escape hatch back
+                # to the flat hash-chain map
+                if prefix_radix is None:
+                    prefix_radix = _env_flag("AIOS_TPU_PREFIX_RADIX")
+                if prefix_radix is None:
+                    prefix_radix = bool(getattr(cfg, "prefix_radix", True))
+                index_cls = (
+                    paged.RadixPrefixIndex if prefix_radix
+                    else paged.PrefixIndex
+                )
+                self.prefix_index = index_cls(
                     self.allocator, max_pages=num_pages
                 )
         else:
@@ -630,6 +660,7 @@ class TPUEngine:
         self._chunk_fns: Dict[Tuple[int, bool], object] = {}
         self._spec_fns: Dict[Tuple[int, int, int], object] = {}
         self._restore_fns: Dict[int, object] = {}
+        self._jump_fns: Dict[int, object] = {}  # run-length-bucketed
         # Unified decode graph: ONE compiled fori_loop over a static
         # max-steps bound with the actual step count as a DYNAMIC operand,
         # so every chunk size the batcher dispatches shares a single XLA
@@ -707,6 +738,11 @@ class TPUEngine:
         self.spec_rounds = 0
         self.spec_tokens = 0
         self.spec_slot_rounds = 0
+        # grammar jump-ahead accounting (jump_step): dispatches and the
+        # forced tokens they appended — each dispatch replaced
+        # jump_tokens/jump_dispatches masked single-token dispatches
+        self.jump_dispatches = 0
+        self.jump_tokens = 0
         # XLA compile-event accounting: every new jit graph counts once
         # and its FIRST dispatch's wall time — jax compiles synchronously
         # inside that call — is recorded as the compile stall. stats(),
@@ -740,6 +776,36 @@ class TPUEngine:
 
         obs.ENGINE_SLOTS_IN_USE.labels(model=name).set_function(slots)
         obs.ENGINE_OCCUPANCY.labels(model=name).set_function(occupancy)
+        # jump-ahead + speculative counters: replica engines share the
+        # (model,) label and set_function is last-writer-wins, so these
+        # read a per-model WeakSet of live engines and report the SUM
+        # (the aios_tpu_prefix_host_* aggregation pattern). Dead engines
+        # drop out when collected.
+        engines = _ENGINES_BY_MODEL.setdefault(name, weakref.WeakSet())
+        engines.add(self)
+
+        def engines_sum(attr):
+            def read() -> float:
+                return float(sum(getattr(e, attr) for e in engines))
+
+            return read
+
+        obs.ENGINE_JUMP_DISPATCHES.labels(model=name).set_function(
+            engines_sum("jump_dispatches")
+        )
+        obs.ENGINE_JUMP_TOKENS.labels(model=name).set_function(
+            engines_sum("jump_tokens")
+        )
+        obs.SPEC_ROUNDS.labels(model=name).set_function(
+            engines_sum("spec_rounds")
+        )
+        obs.SPEC_ACCEPTED.labels(model=name).set_function(
+            # accepted DRAFT tokens: emitted minus the guaranteed one
+            # free token per (slot, round)
+            lambda: float(sum(
+                max(e.spec_tokens - e.spec_slot_rounds, 0) for e in engines
+            ))
+        )
         if self.allocator is not None:
             def pages_in_use() -> float:
                 e = ref()
@@ -1059,6 +1125,91 @@ class TPUEngine:
         state, (tokens, counts) = jax.lax.scan(one, state, None, length=n_rounds)
         return state, (tokens, counts)  # [R, S, K+1], [R, S]
 
+    def _jump_impl(self, params, state: DecodeState, forced, counts,
+                   tables=None):
+        """Grammar jump-ahead: append a host-computed FORCED token run to
+        each jumping slot in ONE multi-token dispatch. ``forced`` [S, K]
+        holds the run tokens (rows padded past ``counts[s]``); a slot with
+        ``counts[s] == c > 0`` scores [last_token, f_1..f_{c-1}] through
+        the speculative-verify forward — acceptance pinned to all-accept:
+        the tokens are grammar-forced, the model's opinion is moot — so
+        its K/V rows land exactly as c masked single-token dispatches
+        would have left them, ``last_tokens`` becomes f_c (the new pending
+        token, K/V written by the next dispatch as usual) and ``lengths``
+        advances by c. Slots with ``counts[s] == 0`` are NO-OPS: lengths
+        and last_tokens unchanged (their row-0 K/V write is the value the
+        next real dispatch rewrites identically; rows past the count land
+        beyond ``lengths`` and are overwritten before ever being read).
+        The RNG key is untouched — nothing samples here, so greedy AND
+        the forced tokens of sampled streams are identical to the
+        per-step path. Logits are computed by the verify forward but
+        discarded; on TPU the dispatch is weight-bandwidth-bound like any
+        decode step, so K forced tokens cost ~one step instead of K."""
+        S, C, K = self.num_slots, self.max_context, forced.shape[1]
+        slots = jnp.arange(S)
+        st = state
+        # same gathered-MoE crossover gate as _spec_impl's verify
+        verify_moe_impl = self._moe_impl
+        if (
+            self._moe_impl == "gather"
+            and S * (K + 1) * self.cfg.num_experts_per_tok
+            >= self.cfg.num_experts
+        ):
+            verify_moe_impl = None
+        feed = jnp.concatenate([st["last_tokens"][:, None], forced], axis=1)
+        scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
+        if self.paged:
+            out = model.verify_step_paged(
+                params, self.cfg, feed, st["lengths"], st["k"], st["v"],
+                tables, cache_scales=scales, active=st["active"],
+                moe_impl=verify_moe_impl, qmm=self._qmm_gspmd,
+            )
+        else:
+            out = model.verify_step(
+                params, self.cfg, feed, st["lengths"], st["k"], st["v"],
+                kernels=self._kernels, cache_scales=scales,
+                active=st["active"], moe_impl=verify_moe_impl,
+                qmm=self._qmm_gspmd,
+            )
+        if self.quant_cache:
+            _logits, k, v, (k_s, v_s) = out
+        else:
+            _logits, k, v = out
+        jumped = counts > 0
+        new_last = jnp.where(
+            jumped,
+            jnp.take_along_axis(feed, counts[:, None], axis=1)[:, 0],
+            st["last_tokens"],
+        )
+        hist = st["history"]
+        if self.track_history:
+            # run tokens land at history cols lengths+1 .. lengths+K
+            # (inside the HISTORY_PAD margin, K <= HISTORY_PAD - 2); cols
+            # past the count are garbage beyond the new length, exactly
+            # like the spec scatter. Non-jumping/inactive slots write the
+            # sacrificial last pad column.
+            hidx = jnp.where(
+                (st["active"] & jumped)[:, None],
+                st["lengths"][:, None] + 1 + jnp.arange(K)[None, :],
+                hist.shape[1] - 1,
+            )
+            hist = hist.at[slots[:, None], hidx].set(forced)
+        new = {
+            "k": k,
+            "v": v,
+            "lengths": jnp.minimum(st["lengths"] + counts, C - 1),
+            "last_tokens": new_last,
+            "temps": st["temps"],
+            "top_ps": st["top_ps"],
+            "active": st["active"],
+            "history": hist,
+            "key": st["key"],
+        }
+        if self.quant_cache:
+            new["k_s"] = k_s
+            new["v_s"] = v_s
+        return new
+
     def _prefill_impl_paged(
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p,
         table_row,
@@ -1324,6 +1475,17 @@ class TPUEngine:
             donate_argnums=(1,),
         )
 
+    def _make_jump_jit(self):
+        if self.paged:
+            return jax.jit(
+                lambda p, s, t, f, c: self._jump_impl(p, s, f, c, t),
+                donate_argnums=(1,),
+            )
+        return jax.jit(
+            lambda p, s, f, c: self._jump_impl(p, s, f, c),
+            donate_argnums=(1,),
+        )
+
     def _make_spec_jit(self, key: Tuple[int, int, int]):
         if self.paged:
             return jax.jit(
@@ -1424,6 +1586,26 @@ class TPUEngine:
         self._compile_aot(
             "spec", self._spec_fns, key, self._make_spec_jit(key),
             self._step_example(),
+        )
+
+    def compile_jump_fn(self, k_bucket: int) -> None:
+        """Ensure the ``k_bucket``-run jump-ahead graph exists WITHOUT
+        dispatching (warmup and the batcher attach call this for every
+        JUMP_BUCKETS size so a constrained tick never compiles
+        mid-serving). No-op where jump dispatches are unsupported (the
+        dp-replicated pool, like speculative verify)."""
+        if k_bucket in self._jump_fns or not self.spec_supported:
+            return
+        args = [self.params, self.state]
+        if self.paged:
+            args.append(jnp.asarray(self.allocator.tables))
+        args += [
+            jnp.zeros((self.num_slots, k_bucket), jnp.int32),
+            jnp.zeros((self.num_slots,), jnp.int32),
+        ]
+        self._compile_aot(
+            "jump", self._jump_fns, k_bucket, self._make_jump_jit(),
+            tuple(args),
         )
 
     def compile_prefill_fn(self, bucket: int) -> None:
@@ -1544,6 +1726,13 @@ class TPUEngine:
         if fn is None:
             fn = self._instrument_compile(self._make_spec_jit(key), "spec")
             self._spec_fns[key] = fn
+        return fn
+
+    def _jump_fn(self, k_bucket: int):
+        fn = self._jump_fns.get(k_bucket)
+        if fn is None:
+            fn = self._instrument_compile(self._make_jump_jit(), "jump")
+            self._jump_fns[k_bucket] = fn
         return fn
 
     def _chunk_fn(self, bucket: int, final: bool):
@@ -1723,7 +1912,8 @@ class TPUEngine:
                 return new
         return jax.jit(impl)
 
-    def _restore_from_host(self, slot: int, entries) -> List[int]:
+    def _restore_from_host(self, slot: int, entries, lead_hashes=(),
+                           lead_pages=()) -> List[int]:
         """Allocate landing pages for a host-tier chain hit, scatter the
         stored KV back into the pool, map the pages as ``slot``'s next
         logical blocks, and re-register their hashes in the HBM index.
@@ -1792,8 +1982,14 @@ class TPUEngine:
         self.allocator.append_owned(slot, pages)
         hashes = [h for h, _ in entries]
         # back in HBM: re-register so the NEXT prompt maps these pages
-        # directly, and drop the host copies (they respill on eviction)
-        self.prefix_index.put(hashes, pages)
+        # directly, and drop the host copies (they respill on eviction).
+        # The lead (HBM-matched) part of the chain rides along so the
+        # radix index can graft the restored segment at its true tree
+        # position — a mid-chain insert has no meaning in a tree (the
+        # flat index just LRU-refreshes the already-present lead).
+        self.prefix_index.put(
+            list(lead_hashes) + hashes, list(lead_pages) + pages
+        )
         self.host_store.discard(hashes, restored=True)
         self.prefix_rows_restored += n * self.allocator.page_size
         return pages
@@ -1837,7 +2033,12 @@ class TPUEngine:
             # from freeing the very pages this prompt just matched
             self.allocator.map_shared(slot, pages)
             self.prefix_rows_reused += len(pages) * P
-        restored = self._restore_from_host(slot, entries) if entries else []
+        restored = (
+            self._restore_from_host(
+                slot, entries, hashes[: len(pages)], pages
+            )
+            if entries else []
+        )
         matched = (len(pages) + len(restored)) * P
         if not matched:
             return 0, hashes
@@ -2110,6 +2311,58 @@ class TPUEngine:
         # do take the lock — need not wait for this dispatch to finish
         return np.asarray(tokens)
 
+    def jump_step(self, forced: np.ndarray, counts: np.ndarray) -> None:
+        """Append grammar-FORCED token runs in ONE multi-token dispatch
+        (compressed-FSM jump-ahead; the batcher's constrained tick).
+
+        ``forced`` [num_slots, K] int32 holds each jumping slot's run
+        (padded past its count); ``counts`` [num_slots] int32 in [0, K] —
+        0 marks a slot this dispatch must not advance. K buckets up to
+        the smallest ``JUMP_BUCKETS`` size (run-length-bucketed graphs,
+        AOT-warmed), so steady-state constrained serving never
+        recompiles. The caller must clamp each run so
+        ``slot_length + counts[s] <= max_context - 2`` (the verify-write
+        contract) and emits the run tokens itself — the forced tokens
+        ARE the dispatch's output by construction."""
+        if not self.spec_supported:
+            raise ValueError(
+                "jump-ahead dispatches are unsupported with a "
+                "dp-replicated page pool (verify_step_paged has no "
+                "shard_map pool twin)"
+            )
+        k = int(forced.shape[1])
+        # round up to a JUMP_BUCKETS size (the exact set warmup compiled
+        # — any other width would lazily build a graph mid-serving)
+        kb = next((b for b in JUMP_BUCKETS if b >= k), None)
+        if kb is None:
+            raise ValueError(
+                f"jump run of {k} tokens exceeds the largest bucket "
+                f"({JUMP_BUCKETS[-1]}); clamp runs to jump_max"
+            )
+        forced = np.asarray(forced, np.int32)
+        if kb > k:
+            forced = np.concatenate(
+                [forced, np.zeros((self.num_slots, kb - k), np.int32)],
+                axis=1,
+            )
+        counts = np.asarray(counts, np.int32)
+        with self._lock:
+            args = ()
+            if self.paged:
+                self._back_active_slots(kb + 1)
+                args = (jnp.asarray(self.allocator.tables),)
+            self.state = self._jump_fn(kb)(
+                self.params, self.state, *args,
+                jnp.asarray(forced), jnp.asarray(counts),
+            )
+            self.decode_steps += 1
+            self._obs_decode_steps.inc()
+            self.jump_dispatches += 1
+            self.jump_tokens += int(counts.sum())
+            self._host_lengths = np.minimum(
+                self._host_lengths + counts, self.max_context - 1
+            )
+
     def force_pending_token(self, slot: int, token_id: int) -> None:
         """Replace ``slot``'s pending (sampled-but-not-yet-consumed) token.
 
@@ -2213,6 +2466,12 @@ class TPUEngine:
             out["spec_tokens_per_round"] = round(
                 self.spec_tokens / max(self.spec_slot_rounds, 1), 2
             )
+            out["spec_accepted"] = max(
+                self.spec_tokens - self.spec_slot_rounds, 0
+            )
+        if self.jump_dispatches:
+            out["jump_dispatches"] = self.jump_dispatches
+            out["jump_tokens"] = self.jump_tokens
         if self.allocator is not None:
             out["kv_pages_in_use"] = self.allocator.pages_in_use()
             out["kv_pages_free"] = self.allocator.free_pages
@@ -2272,6 +2531,7 @@ class TPUEngine:
             self._chunk_fns.clear()
             self._spec_fns.clear()
             self._restore_fns.clear()
+            self._jump_fns.clear()
             self.state = {}
             self.params = None
             self._attn_impl = None
@@ -2295,6 +2555,9 @@ class TPUEngine:
         spec_sizes: Tuple[int, ...] = (),  # speculative round counts
         spec_draft_len: int = 7,
         spec_ngram: int = 3,
+        # jump-ahead run buckets; None -> JUMP_BUCKETS when masked_step
+        # (constrained deployments dispatch jump_step), () to skip
+        jump_sizes: Optional[Tuple[int, ...]] = None,
     ) -> None:
         """AOT-compile every graph the serving path can hit (LoadModel
         readiness gate — the reference's /health polling equivalent,
@@ -2353,6 +2616,17 @@ class TPUEngine:
             self.compile_step_fn(n)
         if masked_step:  # json-mode deployments dispatch step_masked
             self.compile_masked_fn()
+        if jump_sizes is None:
+            # jump-ahead rides the constrained path, but respect the
+            # escape hatch: a deployment that disabled it must not pay
+            # len(JUMP_BUCKETS) jump-graph compiles (and resident
+            # executables) at every engine start
+            enabled = _env_flag("AIOS_TPU_JUMP_AHEAD")
+            if enabled is None:
+                enabled = bool(getattr(self.cfg, "jump_ahead", True))
+            jump_sizes = JUMP_BUCKETS if (masked_step and enabled) else ()
+        for k in jump_sizes:
+            self.compile_jump_fn(k)
         for n in spec_sizes:
             self.compile_spec_fn(n, spec_draft_len, spec_ngram)
         if self.host_store is not None:

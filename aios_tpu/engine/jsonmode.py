@@ -57,14 +57,23 @@ def is_terminal(state: State) -> bool:
     return state[0] == "E" and state[1] == ""
 
 
-def next_state(state: State, b: int, max_depth: int = 16) -> Optional[State]:
-    """One byte transition; None = the byte leaves the grammar."""
+def next_state(state: State, b: int, max_depth: int = 16,
+               compact: bool = False) -> Optional[State]:
+    """One byte transition; None = the byte leaves the grammar.
+
+    ``compact`` disallows inter-element whitespace (string CONTENT keeps
+    its spaces): the grammar then admits exactly canonical compact JSON.
+    Generation-side callers (the batcher's mask caches) use it so that
+    structural positions become SINGLETON states — the compressed-FSM
+    property jump-ahead decoding collapses into multi-token runs — and
+    so a constrained model can never dither on whitespace at the budget
+    edge. Acceptor-side callers keep the default lenient grammar."""
     phase, stack = state[0], state[1]
 
     # -- value-complete: expect ',' / closer / ws (or nothing at top level)
     if phase == "E":
         if b in _WS:
-            return state
+            return None if compact else state
         if not stack:
             return None
         top = stack[-1]
@@ -79,7 +88,7 @@ def next_state(state: State, b: int, max_depth: int = 16) -> Optional[State]:
     # -- expecting a value ('V0' top-level object-only; 'A' value-or-']')
     if phase in ("V", "V0", "A"):
         if b in _WS:
-            return state
+            return None if compact else state
         if phase == "A" and b == ord("]"):
             return ("E", stack[:-1])
         if b == ord("{"):
@@ -113,7 +122,7 @@ def next_state(state: State, b: int, max_depth: int = 16) -> Optional[State]:
     # -- object: expecting a key ('K' also allows '}'; 'K1' after comma)
     if phase in ("K", "K1"):
         if b in _WS:
-            return state
+            return None if compact else state
         if b == ord('"'):
             return ("S", stack, True)
         if phase == "K" and b == ord("}"):
@@ -123,7 +132,7 @@ def next_state(state: State, b: int, max_depth: int = 16) -> Optional[State]:
     # -- expecting ':' after a key
     if phase == "C":
         if b in _WS:
-            return state
+            return None if compact else state
         if b == ord(":"):
             return ("V", stack)
         return None
@@ -206,15 +215,16 @@ def next_state(state: State, b: int, max_depth: int = 16) -> Optional[State]:
             return state
         # a complete number is terminated by whatever may follow a value
         if sub in _NUM_DONE:
-            return next_state(("E", stack), b, max_depth)
+            return next_state(("E", stack), b, max_depth, compact)
         return None
 
     return None
 
 
-def run_bytes(state: State, data: bytes, max_depth: int = 16) -> Optional[State]:
+def run_bytes(state: State, data: bytes, max_depth: int = 16,
+              compact: bool = False) -> Optional[State]:
     for b in data:
-        state = next_state(state, b, max_depth)
+        state = next_state(state, b, max_depth, compact)
         if state is None:
             return None
     return state
@@ -353,15 +363,21 @@ class JsonMaskCache:
         require_object: bool = True,
         max_depth: int = 16,
         byte_matrix=None,  # prebuilt (mat, lens) shared across caches
+        compact: bool = False,  # canonical compact JSON (no structural ws)
     ) -> None:
         self.token_bytes = token_bytes
         self.vocab_size = len(token_bytes)
         self.eos_id = eos_id
         self.require_object = require_object
         self.max_depth = max_depth
+        self.compact = compact
         self._masks: Dict[State, np.ndarray] = {}
         self._closing: Dict[State, np.ndarray] = {}
         self._dist_rows: Dict[State, np.ndarray] = {}
+        # singleton cache: state -> the ONE admissible token id, or None.
+        # Jump-ahead decoding (engine/batching.py) chains these into
+        # multi-token forced runs emitted in a single dispatch.
+        self._singleton: Dict[State, Optional[int]] = {}
         self._dev: Dict[int, object] = {}  # id(np row) -> (row, device)
         self._row_state: object = None  # state of the last mask_row call
         # vectorized-walk precompute: padded byte matrix + global automaton
@@ -399,7 +415,7 @@ class JsonMaskCache:
         return start_state(self.require_object)
 
     def _transition(self, state: State, b: int) -> Optional[State]:
-        return next_state(state, b, self.max_depth)
+        return next_state(state, b, self.max_depth, self.compact)
 
     def _terminal(self, state: State) -> bool:
         return is_terminal(state)
@@ -508,6 +524,53 @@ class JsonMaskCache:
         self._dist_rows[state] = fd
         return fd
 
+    def effective_row(self, state: State, remaining: Optional[int] = None
+                      ) -> np.ndarray:
+        """The row a constrained dispatch actually applies from ``state``.
+        With ``remaining`` (token budget left), tokens are additionally
+        gated on BUDGET FEASIBILITY: a token is allowed only if the state
+        it leads to can still complete within remaining-1 more tokens
+        (distances are bytes, an upper bound on tokens, so feasibility is
+        conservative). By induction the output always completes once the
+        budget ever covered the current distance; a budget infeasible
+        from the start degrades to the pure min-distance closing walk."""
+        self._row_state = state  # device_row cacheability hint
+        base = self.mask_row(state)
+        if remaining is None:
+            return base
+        fd = self.dist_row(state)
+        finite = fd[fd < np.iinfo(np.int32).max]
+        if finite.size and int(finite.min()) > remaining - 1:
+            # nothing fits: close as fast as possible (margin was blown
+            # before the constraint started, e.g. max_tokens < minimal
+            # completion)
+            return self.closing_row(state)
+        if finite.size and int(finite.max()) <= remaining - 1:
+            return base  # every in-grammar token fits: cached row as-is
+        row = np.where(
+            (base == 0.0) & (fd <= remaining - 1),
+            np.float32(0.0),
+            np.float32(NEG_INF),
+        )
+        if self.eos_id is not None and self._terminal(state):
+            row[self.eos_id] = 0.0
+        return row
+
+    def singleton_token(self, state: State) -> Optional[int]:
+        """The single admissible token from ``state``, or None when the
+        mask admits several (or fail-opened). Singleton states are where
+        the grammar FORCES the next token — schema key literals, ``":``,
+        ``",``, closing braces — and chains of them are emitted as one
+        jump-ahead run instead of one masked dispatch each."""
+        tok = self._singleton.get(state, -1)
+        if tok != -1:
+            return tok
+        row = self.mask_row(state)
+        nz = np.flatnonzero(row == 0.0)
+        tok = int(nz[0]) if nz.size == 1 else None
+        self._singleton[state] = tok
+        return tok
+
     def device_row(self, row: np.ndarray):
         """Device-resident copy of a mask row — the per-step [slots, vocab]
         mask is then assembled ON DEVICE (jnp.stack of cached rows), so
@@ -559,35 +622,59 @@ class JsonConstraint:
         self.failed = False
 
     def mask_row(self, remaining: Optional[int] = None) -> np.ndarray:
-        """Mask for the next step. With ``remaining`` (token budget left),
-        tokens are additionally gated on BUDGET FEASIBILITY: a token is
-        allowed only if the state it leads to can still complete within
-        remaining-1 more tokens (distances are bytes, an upper bound on
-        tokens, so feasibility is conservative). By induction the output
-        always completes once the budget ever covered the current
-        distance; a budget infeasible from the start degrades to the
-        pure min-distance closing walk."""
-        self.cache._row_state = self.state  # device_row cacheability hint
-        base = self.cache.mask_row(self.state)
-        if remaining is None:
-            return base
-        fd = self.cache.dist_row(self.state)
-        finite = fd[fd < np.iinfo(np.int32).max]
-        if finite.size and int(finite.min()) > remaining - 1:
-            # nothing fits: close as fast as possible (margin was blown
-            # before the constraint started, e.g. max_tokens < minimal
-            # completion)
-            return self.cache.closing_row(self.state)
-        if finite.size and int(finite.max()) <= remaining - 1:
-            return base  # every in-grammar token fits: cached row as-is
-        row = np.where(
-            (base == 0.0) & (fd <= remaining - 1),
-            np.float32(0.0),
-            np.float32(NEG_INF),
-        )
-        if self.cache.eos_id is not None and self.cache._terminal(self.state):
-            row[self.cache.eos_id] = 0.0
-        return row
+        """Mask for the next step — ``JsonMaskCache.effective_row`` at the
+        cursor's state (budget-feasibility gating documented there)."""
+        return self.cache.effective_row(self.state, remaining)
+
+    def forced_run(
+        self,
+        max_len: int,
+        remaining: Optional[int] = None,
+        stop_ids: Tuple[int, ...] = (),
+    ) -> List[int]:
+        """Longest chain of grammar-FORCED tokens from the current state
+        (compressed-FSM jump-ahead): each step's effective mask admits
+        exactly one token, so ANY sampler must emit it — the batcher
+        emits the whole run host-side and appends its KV in one
+        multi-token dispatch (engine.jump_step) instead of len(run)
+        masked single-token dispatches. Does NOT advance the cursor
+        (``advance`` each token after the dispatch lands).
+
+        Detection stops — conservatively, keeping token streams identical
+        to the per-step path — when the budget-feasibility gate would
+        alter the cached base row, at EOS/stop tokens, or at ``max_len``.
+        """
+        if self.failed or max_len <= 0:
+            return []
+        out: List[int] = []
+        cache, state, rem = self.cache, self.state, remaining
+        imax = np.iinfo(np.int32).max
+        while len(out) < max_len:
+            if rem is not None:
+                fd = cache.dist_row(state)
+                finite = fd[fd < imax]
+                if not finite.size or int(finite.max()) > rem - 1:
+                    break  # budget gating kicks in: per-step path decides
+            tok = cache.singleton_token(state)
+            if tok is None:
+                break
+            out.append(tok)
+            if tok == cache.eos_id or tok in stop_ids:
+                break
+            tb = (
+                cache.token_bytes[tok]
+                if 0 <= tok < cache.vocab_size
+                else None
+            )
+            if not tb:
+                break  # byteless singleton: the cursor would freeze
+            nxt = cache.run(state, tb)
+            if nxt is None:
+                break  # unreachable for an admitted token; fail safe
+            state = nxt
+            if rem is not None:
+                rem -= 1
+        return out
 
     def device_mask(self, remaining: Optional[int] = None):
         """Device-resident mask row for the next step (no per-step PCIe)."""

@@ -174,10 +174,16 @@ SState = Tuple
 
 
 class SchemaMachine:
-    def __init__(self, table: Schema, root: int, max_depth: int = 16) -> None:
+    def __init__(self, table: Schema, root: int, max_depth: int = 16,
+                 compact: bool = False) -> None:
         self.t = table
         self.root = root
         self.max_depth = max_depth
+        # compact: disallow inter-element whitespace (string/enum/key
+        # CONTENT keeps its spaces) so schema-forced positions become
+        # singleton states — the property jump-ahead decoding compresses
+        # into multi-token runs (see jsonmode.next_state's compact doc)
+        self.compact = compact
 
     def start(self) -> SState:
         return ("V", (), self.root)
@@ -193,7 +199,7 @@ class SchemaMachine:
 
         if phase == "E":
             if b in _WS:
-                return st
+                return None if self.compact else st
             if not stack:
                 return None
             top = stack[-1]
@@ -219,17 +225,19 @@ class SchemaMachine:
         if phase == "V":
             nid = st[2]
             if b in _WS:
-                return st
+                return None if self.compact else st
             kind = t.kinds[nid]
             if kind == ANY:
-                inner = jsonmode.next_state(("V", ""), b, self.max_depth)
+                inner = jsonmode.next_state(("V", ""), b, self.max_depth,
+                                            self.compact)
                 if inner is None:
                     return None
                 return self._norm_y(stack, inner, b)
             if kind == ANYOBJ:  # free-form keys/values, but an OBJECT
                 if b != ord("{"):
                     return None
-                inner = jsonmode.next_state(("V", ""), b, self.max_depth)
+                inner = jsonmode.next_state(("V", ""), b, self.max_depth,
+                                            self.compact)
                 return self._norm_y(stack, inner, b)
             if kind == OBJ:
                 if b == ord("{") and len(stack) < self.max_depth:
@@ -268,14 +276,14 @@ class SchemaMachine:
         if phase == "AV":  # first array slot: value or (if allowed) ']'
             nid_items, min_items = st[2], st[3]
             if b in _WS:
-                return st
+                return None if self.compact else st
             if b == ord("]") and min_items == 0:
                 return ("E", stack[:-1])
             return self.step(("V", stack, nid_items), b)
 
         if phase in ("KQ", "KQ1"):
             if b in _WS:
-                return st
+                return None if self.compact else st
             top = stack[-1]
             _, nid, seen = top
             props, required = t.data[nid]
@@ -303,7 +311,7 @@ class SchemaMachine:
         if phase == "C":
             key = st[2]
             if b in _WS:
-                return st
+                return None if self.compact else st
             if b == ord(":"):
                 top = stack[-1]
                 _, nid, seen = top
@@ -390,7 +398,7 @@ class SchemaMachine:
 
         if phase == "Y":  # free-form subtree via the generic machine
             inner = st[2]
-            nxt = jsonmode.next_state(inner, b, self.max_depth)
+            nxt = jsonmode.next_state(inner, b, self.max_depth, self.compact)
             if nxt is None:
                 # the generic machine can't see the schema continuation: a
                 # COMPLETE inner value followed by ',', '}', ']' must pop
@@ -560,15 +568,17 @@ class SchemaMaskCache(JsonMaskCache):
         schema: dict,
         max_depth: int = 16,
         byte_matrix=None,
+        compact: bool = False,
     ) -> None:
         table, root = compile_schema(schema)
-        self.machine = SchemaMachine(table, root, max_depth)
+        self.machine = SchemaMachine(table, root, max_depth, compact=compact)
         super().__init__(
             token_bytes,
             eos_id,
             require_object=True,
             max_depth=max_depth,
             byte_matrix=byte_matrix,
+            compact=compact,
         )
         # the forced opener depends on the root node kind
         root_kind = table.kinds[root]

@@ -384,7 +384,75 @@ class HostPageStore:
             self.bytes_resident = 0
 
 
-class PrefixIndex:
+class _PrefixIndexBase:
+    """Shared plumbing of the prefix-page indexes: the allocator hookup
+    (``reclaimer``), hit/miss counters, the host-tier ``spill`` hook and
+    the lock discipline (the index carries its OWN lock — the serving
+    router peeks it per incoming request, and a probe that had to wait
+    for an in-flight decode dispatch or a multi-second XLA compile would
+    stall pool-wide admission behind one replica's graph build).
+
+    Two implementations share the contract: the legacy flat hash-chain
+    map (:class:`PrefixIndex`, the ``AIOS_TPU_PREFIX_RADIX=0`` escape
+    hatch) and the refcounted radix tree (:class:`RadixPrefixIndex`, the
+    default — SGLang-style cross-request sharing with leaf-LRU
+    eviction)."""
+
+    def __init__(self, allocator: PageAllocator, max_pages: int) -> None:
+        if allocator.replicas != 1:
+            # prefix pages are replica-local under a dp-partitioned pool;
+            # cross-replica sharing is impossible, so the engine disables
+            # the index rather than serve replica-0-only hits
+            raise ValueError(
+                "prefix indexes require an unreplicated pool (replicas=1)"
+            )
+        import threading
+
+        self.alloc = allocator
+        self.max_pages = max_pages
+        self.hits = 0
+        self.misses = 0
+        # host-tier demotion hook: called with evicted (hash, page) pairs
+        # before their references drop (see PrefixIndex docstring); None
+        # keeps the pre-host-tier behavior (evictions just free the pages)
+        self.spill: Optional[
+            Callable[[List[Tuple[bytes, int]]], None]
+        ] = None
+        self._lock = threading.Lock()
+        allocator.reclaimer = self.reclaim
+
+    def reclaim(self, n: int) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _drop(self, evicted: List[Tuple[bytes, int]]) -> None:
+        """Spill evicted entries (hook set), then release their page
+        references. Runs OUTSIDE the index lock — the spill hook enqueues
+        device reads and the router's ``peek`` must not wait on them; the
+        allocator mutation is safe because both eviction paths are
+        reached from engine-lock-holding callers. References drop only
+        AFTER the spill captured the contents, so a freed page can't be
+        reallocated and overwritten mid-copy."""
+        if not evicted:
+            return
+        try:
+            if self.spill is not None:
+                try:
+                    self.spill(evicted)
+                except Exception:  # noqa: BLE001 - degrade to plain evict
+                    log.exception(
+                        "host-tier spill failed; dropping %d page(s)",
+                        len(evicted),
+                    )
+        finally:
+            # the references drop even if the spill dies with a
+            # BaseException (KeyboardInterrupt mid-gather): these entries
+            # are already out of the index, so skipping the decref would
+            # leak their pages for the process lifetime
+            for _, page in evicted:
+                self.alloc.decref(page)
+
+
+class PrefixIndex(_PrefixIndexBase):
     """Content-addressed cache of prompt-prefix pages (hash -> page).
 
     Agent workloads resend the same system/task preamble constantly
@@ -413,33 +481,14 @@ class PrefixIndex:
     """
 
     def __init__(self, allocator: PageAllocator, max_pages: int) -> None:
-        if allocator.replicas != 1:
-            # prefix pages are replica-local under a dp-partitioned pool;
-            # cross-replica sharing is impossible, so the engine disables
-            # the index rather than serve replica-0-only hits
-            raise ValueError(
-                "PrefixIndex requires an unreplicated pool (replicas=1)"
-            )
-        import threading
-
-        self.alloc = allocator
-        self.max_pages = max_pages
+        super().__init__(allocator, max_pages)
         self._index: "OrderedDict[bytes, int]" = OrderedDict()  # hash -> page
-        self.hits = 0
-        self.misses = 0
-        # host-tier demotion hook: called with evicted (hash, page) pairs
-        # before their references drop (see class docstring); None keeps
-        # the pre-host-tier behavior (evictions just free the pages)
-        self.spill: Optional[
-            Callable[[List[Tuple[bytes, int]]], None]
-        ] = None
-        # the index carries its OWN lock (not the engine dispatch lock):
-        # the serving router peeks it per incoming request, and a probe
-        # that had to wait for an in-flight decode dispatch — or a
-        # multi-second first-call XLA compile — would stall pool-wide
-        # admission behind one replica's graph build
-        self._lock = threading.Lock()
-        allocator.reclaimer = self.reclaim
+
+    def snapshot(self) -> Dict[bytes, int]:
+        """Point-in-time hash -> page mapping of every cached block
+        (tests/diagnostics; both index implementations provide it)."""
+        with self._lock:
+            return dict(self._index)
 
     def match(self, hashes: Sequence[bytes]) -> List[int]:
         """Longest indexed prefix of ``hashes``; returns its pages (LRU
@@ -530,29 +579,315 @@ class PrefixIndex:
         self._drop(evicted)
         return len(evicted)
 
-    def _drop(self, evicted: List[Tuple[bytes, int]]) -> None:
-        """Spill evicted entries (hook set), then release their page
-        references. Runs OUTSIDE the index lock — the spill hook enqueues
-        device reads and the router's ``peek`` must not wait on them; the
-        allocator mutation is safe because both eviction paths are
-        reached from engine-lock-holding callers. References drop only
-        AFTER the spill captured the contents, so a freed page can't be
-        reallocated and overwritten mid-copy."""
-        if not evicted:
+
+class _RadixNode:
+    """One path-compressed radix-tree node: a run of consecutive prefix
+    blocks (``entries`` = aligned (chain hash, page) pairs) plus children
+    keyed by the FIRST hash of each child's run. ``stamp`` is the LRU
+    clock at the node's last traversal."""
+
+    __slots__ = ("entries", "children", "parent", "stamp")
+
+    def __init__(self, parent: Optional["_RadixNode"]) -> None:
+        self.entries: List[Tuple[bytes, int]] = []
+        self.children: Dict[bytes, "_RadixNode"] = {}
+        self.parent = parent
+        self.stamp = 0
+
+
+class RadixPrefixIndex(_PrefixIndexBase):
+    """Refcounted radix tree over prompt-prefix token blocks (SGLang-style,
+    arXiv:2312.07104) — the default prefix index.
+
+    Same digest currency as :class:`PrefixIndex` (the ``bytes`` sha256
+    chain of :func:`chain_hashes`; a block's hash commits to everything
+    before it, so a prompt's hash chain IS its tree path), but the tree
+    structure buys what the flat LRU map cannot:
+
+      * **sharing by construction** — eviction is leaf-LRU, bottom-up, so
+        a cached chain's prefix is always cached too. The flat map could
+        evict block 0 of a chain while deeper blocks survived as
+        unreachable garbage, pinning their pages until a pool-pressure
+        reclaim; here that state is unrepresentable.
+      * **divergence-aware structure** — two prompts sharing K leading
+        blocks share one K-entry path and branch below it (path
+        compression splits a node at the divergence point), so the shared
+        preamble's recency is maintained once, by every user, while each
+        cold divergent tail ages out on its own.
+      * **partial-node overlap credit** — ``peek`` counts a match that
+        ends mid-node (a prompt diverging inside another prompt's cached
+        run), so the serving router's overlap score sees the true
+        shareable row count, not floor-to-node granularity.
+
+    Eviction (LRU past ``max_pages``) and pool-pressure ``reclaim`` both
+    pop entries from leaf TAILS (deepest blocks of the coldest chains
+    first) and hand the evicted (hash, page) pairs to the PR 4 ``spill``
+    hook before the references drop — the host-tier demotion contract is
+    unchanged. ``put`` accepts chains whose leading blocks are already
+    cached (the host-tier restore re-inserts a restored segment by
+    passing its lead context), traversing the cached part and grafting
+    only the new suffix."""
+
+    def __init__(self, allocator: PageAllocator, max_pages: int) -> None:
+        super().__init__(allocator, max_pages)
+        self._root = _RadixNode(None)
+        self._size = 0  # total entries (== pages referenced by the tree)
+        self._clock = 0
+
+    # -- internal helpers (caller holds self._lock) -------------------------
+
+    def _split(self, node: _RadixNode, j: int) -> None:
+        """Path-compression split: ``entries[:j]`` stay on ``node``; the
+        suffix moves to a new child that inherits node's children (and
+        node's pre-touch stamp, so the unshared tail ages on its own)."""
+        suffix = node.entries[j:]
+        child = _RadixNode(node)
+        child.entries = suffix
+        child.children = node.children
+        child.stamp = node.stamp
+        for c in child.children.values():
+            c.parent = child
+        node.entries = node.entries[:j]
+        node.children = {suffix[0][0]: child}
+
+    def _leaves(self):
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n is not self._root:
+                yield n
+
+    def _detach(self, node: _RadixNode) -> None:
+        parent = node.parent
+        if parent is None:
             return
-        try:
-            if self.spill is not None:
-                try:
-                    self.spill(evicted)
-                except Exception:  # noqa: BLE001 - degrade to plain evict
-                    log.exception(
-                        "host-tier spill failed; dropping %d page(s)",
-                        len(evicted),
-                    )
-        finally:
-            # the references drop even if the spill dies with a
-            # BaseException (KeyboardInterrupt mid-gather): these entries
-            # are already out of the index, so skipping the decref would
-            # leak their pages for the process lifetime
-            for _, page in evicted:
-                self.alloc.decref(page)
+        for key, child in list(parent.children.items()):
+            if child is node:
+                del parent.children[key]
+                break
+
+    def _evict_overflow(self, evicted: List[Tuple[bytes, int]]) -> None:
+        """Pop deepest blocks of least-recently-used chains until the
+        size fits ``max_pages``. One leaf DFS per VICTIM LEAF, not per
+        entry: the coldest leaf stays the minimum-stamp leaf until it
+        drains, so its whole tail pops under one scan — a bulk overflow
+        (a long prompt registering many blocks at once) holds the index
+        lock for O(overflow + leaves), not O(overflow x tree)."""
+        while self._size > self.max_pages:
+            best = None
+            for leaf in self._leaves():
+                if leaf.entries and (
+                    best is None or leaf.stamp < best.stamp
+                ):
+                    best = leaf
+            if best is None:
+                return
+            while best.entries and self._size > self.max_pages:
+                evicted.append(best.entries.pop())
+                self._size -= 1
+            if not best.entries:
+                self._detach(best)  # parent may become the new leaf
+
+    # -- the PrefixIndex contract -------------------------------------------
+
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
+        """Longest cached prefix of ``hashes``; returns its pages (path
+        stamps refreshed). A match ending mid-node splits it, so the
+        matched run's recency refreshes without dragging the divergent
+        tail along. No references are taken — the caller maps the pages
+        via ``PageAllocator.map_shared`` under the engine lock."""
+        pages: List[int] = []
+        with self._lock:
+            self._clock += 1
+            node, i = self._root, 0
+            while i < len(hashes):
+                child = node.children.get(hashes[i])
+                if child is None:
+                    break
+                j = 0
+                while (
+                    j < len(child.entries)
+                    and i < len(hashes)
+                    and child.entries[j][0] == hashes[i]
+                ):
+                    pages.append(child.entries[j][1])
+                    i += 1
+                    j += 1
+                if j < len(child.entries):
+                    # match ended mid-run (divergence OR a shorter
+                    # prompt): split so only the MATCHED prefix's
+                    # recency refreshes — stamping the whole node would
+                    # keep its cold unmatched tail permanently warm
+                    self._split(child, j)
+                    child.stamp = self._clock
+                    break
+                child.stamp = self._clock
+                node = child
+            if pages:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return pages
+
+    def peek(self, hashes: Sequence[bytes]) -> int:
+        """Length of the longest cached prefix WITHOUT touching hit/miss
+        counters, stamps, or structure — the serving router's read-only
+        overlap probe. Partial-node overlap IS credited: a prompt
+        diverging inside a cached run scores the blocks it shares."""
+        n = 0
+        with self._lock:
+            node, i = self._root, 0
+            while i < len(hashes):
+                child = node.children.get(hashes[i])
+                if child is None:
+                    break
+                j = 0
+                while (
+                    j < len(child.entries)
+                    and i < len(hashes)
+                    and child.entries[j][0] == hashes[i]
+                ):
+                    n += 1
+                    i += 1
+                    j += 1
+                if j < len(child.entries):
+                    break
+                node = child
+        return n
+
+    def put(self, hashes: Sequence[bytes], pages: Sequence[int]) -> None:
+        """Register freshly computed (or host-restored) prefix blocks, one
+        index reference per NEW entry; blocks already cached are traversed
+        (recency refreshed), so callers may pass a chain whose lead is
+        resident — the restore path passes lead + restored segment so the
+        graft lands at the right tree position. Entries past ``max_pages``
+        evict leaf-LRU — spilled to the host tier first when a ``spill``
+        hook is set."""
+        hashes = list(hashes)
+        pages = list(pages)
+        evicted: List[Tuple[bytes, int]] = []
+        with self._lock:
+            self._clock += 1
+            node, i = self._root, 0
+            while i < len(hashes):
+                child = node.children.get(hashes[i])
+                if child is None:
+                    break
+                j = 0
+                while (
+                    j < len(child.entries)
+                    and i < len(hashes)
+                    and child.entries[j][0] == hashes[i]
+                ):
+                    i += 1
+                    j += 1
+                if j < len(child.entries):
+                    # split BEFORE stamping (divergence OR a shorter
+                    # chain): the unshared suffix keeps the node's old
+                    # stamp and ages on its own
+                    self._split(child, j)
+                    node = child
+                    child.stamp = self._clock
+                    break
+                child.stamp = self._clock
+                node = child
+            if i < len(hashes) and i < len(pages):
+                new = _RadixNode(node)
+                new.stamp = self._clock
+                for h, page in zip(hashes[i:], pages[i:]):
+                    self.alloc.incref(page)
+                    new.entries.append((h, page))
+                node.children[hashes[i]] = new
+                self._size += len(new.entries)
+            self._evict_overflow(evicted)
+        self._drop(evicted)
+
+    def clear(self) -> None:
+        """Drop every entry (and its page reference) WITHOUT spilling —
+        the warmup/shutdown path (synthetic blocks must not pollute the
+        host tier)."""
+        with self._lock:
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                for _, page in n.entries:
+                    self.alloc.decref(page)
+                stack.extend(n.children.values())
+            self._root = _RadixNode(None)
+            self._size = 0
+
+    def reclaimable(self) -> int:
+        """How many entries ``reclaim`` could free right now: an entry is
+        reclaimable iff its page is held ONLY by the tree (refcount 1)
+        AND everything below it in its subtree is reclaimable too —
+        removal is suffix-of-tree only, or a cached chain would lose a
+        middle block and strand its tail."""
+        with self._lock:
+            total = 0
+            fully: Dict[int, bool] = {}
+            stack: List[Tuple[_RadixNode, bool]] = [(self._root, False)]
+            while stack:
+                node, seen = stack.pop()
+                if not seen:
+                    stack.append((node, True))
+                    for c in node.children.values():
+                        stack.append((c, False))
+                    continue
+                f = all(
+                    fully.pop(id(c)) for c in node.children.values()
+                )
+                if f:
+                    run = 0
+                    for _, page in reversed(node.entries):
+                        if self.alloc.refcount(page) == 1:
+                            run += 1
+                        else:
+                            break
+                    total += run
+                    f = run == len(node.entries)
+                fully[id(node)] = f
+            return total
+
+    def reclaim(self, n: int) -> int:
+        """Drop up to ``n`` cold entries whose pages are held ONLY by the
+        tree — called by the allocator when the free list runs dry.
+        Bottom-up and LRU-first: tail entries of the coldest leaves pop
+        until a live-shared page blocks that chain; a leaf that empties
+        detaches, exposing its parent's tail next. Dropped pages spill to
+        the host tier (hook set) before they free."""
+        evicted: List[Tuple[bytes, int]] = []
+        with self._lock:
+            while len(evicted) < n:
+                cands = [
+                    l for l in self._leaves()
+                    if l.entries
+                    and self.alloc.refcount(l.entries[-1][1]) == 1
+                ]
+                if not cands:
+                    break
+                leaf = min(cands, key=lambda l: l.stamp)
+                while (
+                    leaf.entries
+                    and len(evicted) < n
+                    and self.alloc.refcount(leaf.entries[-1][1]) == 1
+                ):
+                    evicted.append(leaf.entries.pop())
+                    self._size -= 1
+                if not leaf.entries:
+                    self._detach(leaf)
+        self._drop(evicted)
+        return len(evicted)
+
+    def snapshot(self) -> Dict[bytes, int]:
+        """Point-in-time hash -> page mapping of every cached block
+        (tests/diagnostics; same contract as ``PrefixIndex.snapshot``)."""
+        with self._lock:
+            out: Dict[bytes, int] = {}
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                out.update(n.entries)
+                stack.extend(n.children.values())
+            return out

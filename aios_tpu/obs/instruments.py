@@ -112,7 +112,8 @@ ENGINE_POOL_EVICTIONS = Counter(
 )
 ENGINE_XLA_COMPILES = Counter(
     "aios_tpu_engine_xla_compiles_total",
-    "XLA graph builds by kind (step|masked|prefill|chunk|spec|hist|restore)",
+    "XLA graph builds by kind "
+    "(step|masked|prefill|chunk|spec|jump|hist|restore)",
     ("model", "kind"),
 )
 ENGINE_XLA_COMPILE_SECONDS = Histogram(
@@ -148,6 +149,51 @@ ENGINE_DISPATCH_FLUSHES = Counter(
     "Pipelined decode flushes by cause "
     "(constrained|spec|evict|idle)",
     ("model", "cause"),
+)
+
+# -- grammar jump-ahead decoding (engine.jump_step; batching constrained
+# tick) — monotonic engine counters read at scrape time, SUMMED over a
+# per-model WeakSet of live replica engines (set_function is last-writer-
+# wins; the aios_tpu_prefix_host_* aggregation pattern).
+
+ENGINE_JUMP_DISPATCHES = Gauge(
+    "aios_tpu_engine_jump_ahead_dispatches_total",
+    "Multi-token jump-ahead dispatches (each replaced a chain of masked "
+    "single-token dispatches; monotonic, summed over replica engines)",
+    ("model",),
+)
+ENGINE_JUMP_TOKENS = Gauge(
+    "aios_tpu_engine_jump_ahead_tokens_total",
+    "Grammar-forced tokens emitted via jump-ahead runs (monotonic, "
+    "summed over replica engines)",
+    ("model",),
+)
+
+# -- n-gram speculative decoding (engine.spec_step; ROADMAP item) ----------
+# Rounds/accepted are engine counters (WeakSet-summed like the jump
+# family); the acceptance ratio is the per-batcher EWMA driving the
+# AIOS_TPU_SPEC_MIN_ACCEPT auto-disable, averaged over live replica
+# batchers at scrape time.
+
+SPEC_ROUNDS = Gauge(
+    "aios_tpu_spec_rounds_total",
+    "Speculative verify rounds dispatched (monotonic, summed over "
+    "replica engines)",
+    ("model",),
+)
+SPEC_ACCEPTED = Gauge(
+    "aios_tpu_spec_accepted_total",
+    "Draft tokens accepted by speculative verify (emitted tokens minus "
+    "the one guaranteed token per slot-round; monotonic, summed over "
+    "replica engines)",
+    ("model",),
+)
+SPEC_ACCEPTANCE = Gauge(
+    "aios_tpu_spec_acceptance_ratio",
+    "EWMA draft-acceptance ratio (accepted / proposed) per model, "
+    "averaged over replica batchers; drives the AIOS_TPU_SPEC_MIN_ACCEPT "
+    "auto-disable",
+    ("model",),
 )
 
 # -- prefix-cache host spill tier (engine/paged.py HostPageStore) ----------

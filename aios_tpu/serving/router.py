@@ -9,11 +9,14 @@ with a page-table update instead of a prefill. Selection order:
   1. **sticky** — a ``task_id`` continuation goes back to the replica
      that served the task before (its whole conversation KV lives there);
   2. **prefix** — score every replica by prefix-cache overlap with the
-     prompt ids (a read-only peek at the existing
-     ``paged.PrefixIndex`` state — no hit/miss counters touched, no LRU
-     refresh) and take the best one when the overlap covers at least
-     ``overlap_min_ratio`` of the prompt. Rows resident only in a
-     replica's host spill tier (``paged.HostPageStore``) count at
+     prompt ids (a read-only peek at the replica's prefix index — the
+     radix tree ``paged.RadixPrefixIndex`` by default, which credits
+     PARTIAL-node overlap: a prompt diverging inside another prompt's
+     cached run still scores the blocks it shares — no hit/miss
+     counters touched, no LRU refresh, no node splits) and take the
+     best one when the overlap covers at least ``overlap_min_ratio``
+     of the prompt. Rows resident only in a replica's host spill tier
+     (``paged.HostPageStore``) count at
      ``paged.HOST_OVERLAP_DISCOUNT``: a restorable prefix is a memcpy,
      not free, so routing still prefers true HBM residency but credits
      the replica that can restore over one that must recompute;

@@ -288,7 +288,7 @@ def test_reclaim_spill_restore_interleaving_invariants(params):
         time.sleep(0.02)
     alloc = eng.allocator
     free = set(alloc._free[0])
-    indexed = set(eng.prefix_index._index.values())
+    indexed = set(eng.prefix_index.snapshot().values())
     mapped = set()
     for s in range(eng.num_slots):
         used = int(alloc._blocks_used[s])
@@ -351,7 +351,7 @@ def test_warmup_leaves_host_store_empty(params):
     eng = make_engine(params, paged_pool_rows=1024)
     eng.warmup(step_sizes=(1,))
     assert len(eng.host_store) == 0
-    assert len(eng.prefix_index._index) == 0
+    assert len(eng.prefix_index.snapshot()) == 0
     # the tier still works after warmup
     rng = np.random.default_rng(12)
     prompt = [int(t) for t in rng.integers(1, 500, 100)]

@@ -138,6 +138,76 @@ def test_prefix_host_labels_are_model_only():
             )
 
 
+# -- the grammar jump-ahead family (engine.jump_step, ISSUE 7) -------------
+
+ENGINE_JUMP_EXPECTED = {
+    "aios_tpu_engine_jump_ahead_dispatches_total": "gauge",
+    "aios_tpu_engine_jump_ahead_tokens_total": "gauge",
+}
+
+
+def test_engine_jump_ahead_family_complete_and_typed():
+    """The jump-ahead instruments the ISSUE 7 catalog promises exist,
+    with the promised kinds — and any NEW aios_tpu_engine_jump_ahead_*
+    metric must be added here (and to docs/ENGINE_PERF.md +
+    OBSERVABILITY.md) so the family stays reviewed. They are monotonic
+    engine counters read at scrape time over a per-model WeakSet of
+    replica engines (set_function is last-writer-wins — the
+    aios_tpu_prefix_host_* lesson, not repeated a third time)."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_engine_jump_ahead_")
+    }
+    assert family == ENGINE_JUMP_EXPECTED
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_engine_jump_ahead_"):
+            assert tuple(m.labelnames) == ("model",), (
+                f"{m.name}: jump-ahead metrics carry exactly the model "
+                f"label (replicas aggregate through the engine WeakSet)"
+            )
+
+
+def test_engine_jump_ahead_gauges_aggregate_over_engine_weakset():
+    """The scrape callbacks must SUM over _ENGINES_BY_MODEL — a bare
+    weakref.ref(self) registration would report only the last replica."""
+    import inspect
+
+    from aios_tpu.engine import engine as engine_mod
+
+    src = inspect.getsource(engine_mod.TPUEngine._register_gauges)
+    assert "_ENGINES_BY_MODEL" in src
+    for name in ("ENGINE_JUMP_DISPATCHES", "ENGINE_JUMP_TOKENS",
+                 "SPEC_ROUNDS", "SPEC_ACCEPTED"):
+        assert name in src, f"{name} not registered over the WeakSet"
+
+
+# -- the speculative-decode family (engine.spec_step + batcher EWMA) -------
+
+SPEC_EXPECTED = {
+    "aios_tpu_spec_rounds_total": "gauge",
+    "aios_tpu_spec_accepted_total": "gauge",
+    "aios_tpu_spec_acceptance_ratio": "gauge",
+}
+
+
+def test_spec_family_complete_and_typed():
+    """The speculative-decode instruments the ROADMAP item promises
+    exist, with the promised kinds — rounds/accepted are WeakSet-summed
+    engine counters; the acceptance ratio is the per-batcher EWMA that
+    drives the AIOS_TPU_SPEC_MIN_ACCEPT auto-disable, averaged over
+    replica batchers."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_spec_")
+    }
+    assert family == SPEC_EXPECTED
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_spec_"):
+            assert tuple(m.labelnames) == ("model",), (
+                f"{m.name}: spec metrics carry exactly the model label"
+            )
+
+
 # -- the decode dispatch family (pipelined batcher, engine/batching.py) ----
 
 ENGINE_DISPATCH_EXPECTED = {

@@ -615,7 +615,7 @@ def test_prefix_chunked_admission_hit(params):
 def test_warmup_leaves_prefix_index_empty(params):
     eng = make_paged(params, pool_rows=1024, page_size=32)
     eng.warmup(step_sizes=(1,))
-    assert len(eng.prefix_index._index) == 0
+    assert len(eng.prefix_index.snapshot()) == 0
     assert eng.allocator.pages_in_use() == 0
     out1 = eng.generate([1, 2, 3], max_new_tokens=8, temperature=0.0)
     assert len(out1) == 8
@@ -844,7 +844,7 @@ def test_paged_cancel_eviction_prefix_soak(params):
         alloc = engine.allocator
         # quiesced accounting: usable pages (total minus the sacrificial
         # page) = free pages + pages pinned by the prefix index
-        pinned = len(set(engine.prefix_index._index.values()))
+        pinned = len(set(engine.prefix_index.snapshot().values()))
         usable = alloc.num_pages - alloc.replicas
         assert alloc.free_pages + pinned == usable, (
             alloc.free_pages, pinned, usable,
