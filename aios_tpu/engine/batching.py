@@ -34,6 +34,7 @@ from .engine import (
 )
 from .paged import PoolExhausted
 from ..obs import instruments as obs
+from ..obs import flightrec
 
 log = logging.getLogger("aios.batcher")
 
@@ -82,6 +83,11 @@ class Request:
     # it from the request's intelligence level so strategic reasoning
     # doesn't queue behind bulk operational traffic)
     priority: int = 0
+    # flight-recorder timeline (obs/flightrec.py) riding the request
+    # through admission -> routing -> scheduling; opened by the runtime
+    # service (with tenant + trace context), the pool, or the batcher —
+    # whoever sees the request first. None when recording is disabled.
+    rec: object = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -303,6 +309,7 @@ class ContinuousBatcher:
         self._waiting: "deque[_Live]" = deque()
         self._qlock = threading.Lock()
         self._prefilling: Optional[Tuple[_Live, ChunkedPrefill]] = None
+        self._prefill_chunks = 0  # chunks of the in-flight admission
         self._reserved_slot = -1  # slot mid-chunked-prefill (not yet active)
         self._live: Dict[int, _Live] = {}  # slot -> request
         self._wake = threading.Event()
@@ -528,6 +535,15 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if not req.request_id:
             req.request_id = f"req-{next(self._ids)}"
+        if req.rec is None:
+            # direct batcher callers (tests, bench) still get a timeline;
+            # serving-path requests arrive with one already opened
+            req.rec = flightrec.RECORDER.begin(
+                self.engine.cfg.name, req.request_id,
+                prompt_tokens=len(req.prompt_ids), priority=req.priority,
+            )
+        elif not req.rec.request_id:
+            req.rec.request_id = req.request_id  # auto-assigned id above
         live = _Live(req=req, slot=-1, submitted_at=time.monotonic())
         if req.json_schema is not None:
             from . import jsonmode
@@ -608,6 +624,10 @@ class ContinuousBatcher:
         if self._prefilling is None:
             return
         live, pc = self._prefilling
+        t0 = time.monotonic()
+        pos0 = pc.pos
+        reused0 = getattr(self.engine, "prefix_rows_reused", 0)
+        restored0 = getattr(self.engine, "prefix_rows_restored", 0)
         while True:
             try:
                 first = pc.step()
@@ -631,8 +651,17 @@ class ContinuousBatcher:
                     live.done = True
                     live.abort_reason = "evicted: KV pool exhausted"
                     self.engine.release(live.slot)
+                    self._rec_close(live)
                     live.out_q.put(_END)
                     return
+        self._prefill_chunks += 1
+        # tokens = rows actually consumed this chunk (the FINAL chunk is
+        # usually partial — recording the nominal chunk size would
+        # overstate the prompt in every chunked timeline)
+        self._rec_prefill(
+            live, pc.pos - pos0, t0, reused0, restored0,
+            chunk=self._prefill_chunks,
+        )
         if first is not None:
             self._prefilling = None
             self._reserved_slot = -1
@@ -675,6 +704,13 @@ class ContinuousBatcher:
                     self.queue_wait_obs.observe(
                         live.admitted_at - live.submitted_at
                     )
+                rec = live.req.rec
+                if rec is not None:
+                    wait_ms = (
+                        live.admitted_at - live.submitted_at
+                    ) * 1000.0
+                    rec.queue_wait_ms = wait_ms
+                    rec.event("queue", wait_ms=round(wait_ms, 3))
             alloc = self.engine.allocator
             if alloc is not None and alloc.replicas > 1:
                 # dp-partitioned pool: admit onto the replica with the
@@ -710,6 +746,7 @@ class ContinuousBatcher:
                 )
                 live.done = True
                 live.abort_reason = "prompt exceeds the KV page pool"
+                self._rec_close(live)
                 live.out_q.put(_END)
                 continue
             chunked = self.prefill_chunk is not None and len(ids) > self.prefill_chunk
@@ -729,8 +766,12 @@ class ContinuousBatcher:
                         chunk=self.prefill_chunk,
                     ),
                 )
+                self._prefill_chunks = 0
                 self._reserved_slot = slot
                 continue
+            t0 = time.monotonic()
+            reused0 = getattr(self.engine, "prefix_rows_reused", 0)
+            restored0 = getattr(self.engine, "prefix_rows_restored", 0)
             try:
                 first = self.engine.prefill(
                     slot,
@@ -751,11 +792,13 @@ class ContinuousBatcher:
                         self._waiting.popleft()
                     live.done = True
                     live.abort_reason = "prompt exceeds the KV page pool"
+                    self._rec_close(live)
                     live.out_q.put(_END)
                 # "blocked": the pool is held by strictly higher-priority
                 # streams — the admission stays queued and retries as they
                 # drain; "evicted": retry next pass with the freed pages
                 return
+            self._rec_prefill(live, len(ids), t0, reused0, restored0)
             if live.constraint is not None:
                 first = self._constrained_first(live, first)
             live.first_token_at = time.monotonic()
@@ -853,15 +896,18 @@ class ContinuousBatcher:
         ).inc()
         self._consume(tick)
 
-    def _note_dispatch(self) -> None:
-        """Record the host gap since the previous decode dispatch (the
-        window the device idles in the sync loop; the pipeline's whole
-        point is to hide it). Call immediately BEFORE dispatching; the
-        dispatch site stamps ``_gap_mark`` when the engine call returns.
+    def _note_dispatch(self) -> Optional[float]:
+        """Record and return the host gap since the previous decode
+        dispatch (the window the device idles in the sync loop; the
+        pipeline's whole point is to hide it) — None for the first
+        dispatch after an idle boundary. Call immediately BEFORE
+        dispatching; the dispatch site stamps ``_gap_mark`` when the
+        engine call returns.
         Time the pipelined tick spent BLOCKED waiting on the previous
         dispatch's tokens (``_gap_wait``) is subtracted — that's device
         time, and counting it would make the pipelined gap read as if
         the host were busier than the sync loop's."""
+        gap = None
         if self._gap_mark is not None:
             gap = time.monotonic() - self._gap_mark - self._gap_wait
             gap = max(gap, 0.0)
@@ -869,6 +915,74 @@ class ContinuousBatcher:
             self.decode_dispatches += 1
             self._obs_gap.observe(gap)
         self._gap_wait = 0.0
+        return gap
+
+    # -- flight-recorder hooks (obs/flightrec.py) ---------------------------
+    # One event per DISPATCH per live request — never per token — and
+    # every call is a no-op when the request carries no timeline, so the
+    # recorder can be disabled without touching a single dispatch.
+
+    def _rec_dispatch(self, lives, kind: str, n: int,
+                      gap: Optional[float] = None,
+                      dur_s: Optional[float] = None, **extra) -> None:
+        occ = len(lives)
+        fields = dict(n=n, occ=occ, **extra)
+        if gap is not None:
+            fields["gap_ms"] = round(gap * 1e3, 3)
+        if dur_s is not None:
+            fields["dur_ms"] = round(dur_s * 1e3, 3)
+        for live in lives:
+            rec = live.req.rec
+            if rec is not None and not live.done:
+                rec.event(kind, **fields)
+
+    def _rec_prefill(self, live: _Live, tokens: int, t0: float,
+                     reused0: float, restored0: float,
+                     chunk: Optional[int] = None) -> None:
+        rec = live.req.rec
+        if rec is None:
+            return
+        fields = dict(
+            tokens=tokens,
+            dur_ms=round((time.monotonic() - t0) * 1e3, 3),
+        )
+        cached = getattr(self.engine, "prefix_rows_reused", 0) - reused0
+        restored = (
+            getattr(self.engine, "prefix_rows_restored", 0) - restored0
+        )
+        if cached:
+            fields["cached_rows"] = int(cached)
+        if restored:
+            fields["restored_rows"] = int(restored)
+        if chunk is not None:
+            fields["chunk"] = chunk
+        rec.event("prefill", **fields)
+
+    def _rec_close(self, live: _Live) -> None:
+        """Finalize the request's timeline into the recorder ring —
+        called on EVERY end-of-life path, right before the consumer's
+        end-of-stream lands."""
+        rec = live.req.rec
+        if rec is None:
+            return
+        rec.tokens_out = live.produced
+        if live.first_token_at:
+            rec.ttft_ms = (
+                live.first_token_at - live.submitted_at
+            ) * 1000.0
+            if live.produced > 1:
+                rec.tpot_ms = (
+                    (time.monotonic() - live.first_token_at) * 1000.0
+                    / (live.produced - 1)
+                )
+        if live.abort_reason:
+            flightrec.RECORDER.finish(
+                rec, "aborted", abort_reason=live.abort_reason
+            )
+        elif live.cancelled:
+            flightrec.RECORDER.finish(rec, "cancelled")
+        else:
+            flightrec.RECORDER.finish(rec, "retired")
 
     def _finish(self, live: _Live, *, was_cancelled: bool = False,
                 abort_reason: str = "") -> None:
@@ -887,6 +1001,7 @@ class ContinuousBatcher:
         else:
             self.completed += 1
             self._obs_completed.inc()
+        self._rec_close(live)
         # _END goes last: when a consumer unblocks, all scheduler-side state
         # (slot freed, counters bumped) is already final
         live.out_q.put(_END)
@@ -907,6 +1022,7 @@ class ContinuousBatcher:
             live.done = True
             self.cancellations += 1
             self._obs_cancelled.inc()
+            self._rec_close(live)
             live.out_q.put(_END)
         if self._prefilling is not None and self._prefilling[0].cancelled:
             live = self._prefilling[0]
@@ -1008,6 +1124,7 @@ class ContinuousBatcher:
                     self.engine.release(live.slot)
                 except Exception:  # noqa: BLE001
                     pass
+            self._rec_close(live)
             live.out_q.put(_END)
 
     def _run(self) -> None:
@@ -1110,17 +1227,27 @@ class ContinuousBatcher:
             forced[s_, : len(run)] = run
             counts[s_] = len(run)
         try:
-            self._note_dispatch()
+            gap = self._note_dispatch()
+            t0 = time.monotonic()
             self.engine.jump_step(forced, counts)
             self._gap_mark = time.monotonic()
         except PoolExhausted as e:
             self._evict_longest(e.replica)  # retry next tick
             return True
+        dur_ms = round((self._gap_mark - t0) * 1e3, 3)
         by_slot = dict(constrained)
         for s_ in sorted(runs):
             live = by_slot[s_]
             if live.done:
                 continue
+            rec = live.req.rec
+            if rec is not None:
+                rec.event(
+                    "jump", k=len(runs[s_]), occ=len(runs),
+                    dur_ms=dur_ms,
+                    **({"gap_ms": round(gap * 1e3, 3)}
+                       if gap is not None else {}),
+                )
             for tok in runs[s_]:
                 live.constraint.advance(tok)
                 self._emit(live, tok)
@@ -1200,12 +1327,17 @@ class ContinuousBatcher:
                     jnp.stack(rows)
                 )
             try:
-                self._note_dispatch()
+                gap = self._note_dispatch()
+                t0 = time.monotonic()
                 tokens = self.engine.step_masked(mask)
                 self._gap_mark = time.monotonic()
             except PoolExhausted as e:
                 self._evict_longest(e.replica)
                 return
+            self._rec_dispatch(
+                slots.values(), "decode", 1, gap,
+                self._gap_mark - t0, constrained=True,
+            )
             for slot, live in list(slots.items()):
                 if live.done:
                     continue
@@ -1232,7 +1364,8 @@ class ContinuousBatcher:
             # drain any pending plain dispatch first.
             self._flush_pending("spec")
             try:
-                self._note_dispatch()
+                gap = self._note_dispatch()
+                t0 = time.monotonic()
                 tokens, counts = self.engine.spec_step(
                     n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
                 )
@@ -1240,6 +1373,7 @@ class ContinuousBatcher:
             except PoolExhausted as e:
                 self._evict_longest(e.replica)  # retry next tick
                 return
+            dur_ms = round((self._gap_mark - t0) * 1e3, 3)
             consumed: Dict[int, int] = {}
             for r in range(tokens.shape[0]):
                 for slot, live in list(slots.items()):
@@ -1250,6 +1384,19 @@ class ContinuousBatcher:
                         self._emit(live, int(tokens[r, slot, j]))
                         if live.done:
                             break
+            for slot, live in slots.items():
+                rounds = consumed.get(slot)
+                rec = live.req.rec
+                if rec is not None and rounds:
+                    # emitted = rounds + accepted drafts for this slot's
+                    # SERVED rounds (the _spec_measure accounting)
+                    rec.event(
+                        "spec", rounds=rounds,
+                        emitted=int(counts[:rounds, slot].sum()),
+                        draft_len=self.spec_draft_len, dur_ms=dur_ms,
+                        **({"gap_ms": round(gap * 1e3, 3)}
+                           if gap is not None else {}),
+                    )
             self._spec_measure(counts, consumed)
             return
         if self.pipeline:
@@ -1264,15 +1411,19 @@ class ContinuousBatcher:
             # columns the sync loop would never have dispatched. A
             # PoolExhausted surfaces at consume time (_consume evicts).
             prev = self._pending
-            self._note_dispatch()
+            gap = self._note_dispatch()
             handle = self.engine.step_async(n)
             self._gap_mark = time.monotonic()
             self._pending = _PendingTick(handle, slots)
+            self._rec_dispatch(
+                slots.values(), "decode", n, gap, pipelined=True
+            )
             if prev is not None:
                 self._consume(prev)
             return
         try:
-            self._note_dispatch()
+            gap = self._note_dispatch()
+            t0 = time.monotonic()
             tokens = self.engine.step(n)  # [n, num_slots]
             self._gap_mark = time.monotonic()
         except PoolExhausted as e:
@@ -1280,6 +1431,9 @@ class ContinuousBatcher:
             # failed ensure() left all engine state untouched
             self._evict_longest(e.replica)
             return
+        self._rec_dispatch(
+            slots.values(), "decode", n, gap, self._gap_mark - t0
+        )
         for step_row in tokens:
             for slot, live in list(slots.items()):
                 if live.done:

@@ -37,6 +37,7 @@ import numpy as np
 from . import model, paged, sampling, spec
 from .config import ModelConfig
 from ..obs import instruments as obs
+from ..obs import flightrec
 
 log = logging.getLogger("aios.engine")
 
@@ -1837,6 +1838,12 @@ class TPUEngine:
                 self._spill_pending -= len(evicted)
             raise
         self._spill_q.put(([h for h, _ in evicted], arrs))
+        # flight-recorder model lane: spills belong to the MODEL's story
+        # (pressure from whichever request forced the eviction), not to
+        # one request's timeline — /debug/trace renders them on tid 0
+        flightrec.RECORDER.model_event(
+            self.cfg.name, "spill", pages=len(evicted)
+        )
 
     @staticmethod
     def _spill_worker(q, store, lock, eng_ref) -> None:
@@ -1992,6 +1999,10 @@ class TPUEngine:
         )
         self.host_store.discard(hashes, restored=True)
         self.prefix_rows_restored += n * self.allocator.page_size
+        flightrec.RECORDER.model_event(
+            self.cfg.name, "restore", pages=n,
+            rows=n * self.allocator.page_size,
+        )
         return pages
 
     def _match_prefix(self, slot: int, ids: List[int]):

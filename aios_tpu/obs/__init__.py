@@ -15,8 +15,17 @@ package is that substrate for the aiOS-TPU stack:
   * ``obs.interceptors``— gRPC client/server interceptors wiring every
                           RPC into rpc_{requests,errors,latency} metrics
                           and the span tree (installed by aios_tpu.rpc);
-  * ``obs.http``        — stdlib /metrics + /healthz endpoint each
-                          service's serve() can start.
+  * ``obs.flightrec``   — the serving-plane flight recorder: one bounded
+                          structured timeline per request (admission ->
+                          route -> queue -> prefill -> decode ticks ->
+                          retirement), Chrome-trace export, anomaly
+                          snapshots;
+  * ``obs.slo``         — windowed TTFT/TPOT/availability objectives per
+                          model computed from the recorder, exported as
+                          the ``aios_tpu_slo_*`` family and folded into
+                          every /healthz;
+  * ``obs.http``        — stdlib /metrics + /healthz + /debug/* endpoint
+                          each service's serve() can start.
 
 No third-party dependencies: prometheus_client is not in the image, so
 the registry is self-contained stdlib code.
@@ -40,3 +49,12 @@ from .tracing import (  # noqa: F401
     start_span,
 )
 from .http import start_metrics_server, maybe_start_metrics_server  # noqa: F401
+from . import flightrec  # noqa: F401
+from . import slo  # noqa: F401 - registers the recorder's SLO listener
+from .flightrec import RECORDER, FlightRecorder, Timeline  # noqa: F401
+
+# Wire the previously-dormant span-exporter hook: finished spans fold
+# into the matching request timeline by trace id (a deployment's own
+# set_exporter call, made before or after import, wins — install only
+# claims the hook when it is free).
+flightrec.install_span_export()

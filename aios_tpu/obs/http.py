@@ -1,13 +1,31 @@
-"""Stdlib /metrics + /healthz endpoint for every service.
+"""Stdlib /metrics + /healthz + /debug endpoint for every service.
 
 Each service's ``serve()`` can start one next to its gRPC port — either
 by passing ``metrics_port`` explicitly or via the per-service env var
 ``AIOS_<SERVICE>_METRICS_PORT`` (0 = ephemeral port, useful in tests);
 ``AIOS_METRICS_HOST`` widens the bind beyond the 127.0.0.1 default for
 external scrapers.
-A Prometheus scrape of ``/metrics`` sees the process-wide default
-registry; ``/healthz`` answers a JSON liveness probe (optionally backed
-by a service-supplied callable).
+
+Routes:
+  * ``/metrics``   — Prometheus text exposition of the process registry;
+  * ``/livez``     — pure liveness: always 200 while the process
+    answers (point restart-on-failure probes here);
+  * ``/healthz``   — JSON readiness/health probe (service-supplied
+    ``health_fn`` merged in; the runtime's health_fn folds the SLO view
+    in via ``slo.annotate_health``). Returns **503** whenever the
+    payload's status is not ``ok`` — a degraded service or an SLO
+    breach takes the replica out of LB rotation, without the process
+    kill a liveness probe would cause;
+  * ``/debug/requests``  — recent flight-recorder timelines (JSON;
+    ``?model=&limit=&events=0``);
+  * ``/debug/trace``     — the same timelines as Chrome trace-event /
+    Perfetto JSON (``?model=&limit=``, or ``?snapshot=<id>`` to render a
+    frozen anomaly snapshot);
+  * ``/debug/spans``     — the finished-span ring (``?name=&limit=``);
+  * ``/debug/slo``       — per-model objective evaluation + per-tenant
+    breakdown;
+  * ``/debug/snapshots`` — frozen anomaly snapshots (``?id=`` for one,
+    metadata list otherwise).
 """
 
 from __future__ import annotations
@@ -18,10 +36,121 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 from .metrics import REGISTRY, MetricsRegistry
 
 log = logging.getLogger("aios.obs")
+
+
+def _debug_response(
+    path: str, query: dict,
+) -> Optional[Tuple[bytes, str, int]]:
+    """Render one /debug/* route -> (body, content_type, status), or
+    None for an unknown path. flightrec/slo import at call time because
+    the obs package __init__ imports THIS module before them (they are
+    package-level imports everywhere else — every process importing
+    aios_tpu.obs has them loaded)."""
+    from . import flightrec, slo, tracing
+
+    def q(name: str, default: str = "") -> str:
+        return query.get(name, [default])[0]
+
+    def qint(name: str, default: int) -> int:
+        try:
+            return int(q(name, str(default)))
+        except ValueError:
+            return default
+
+    status = 200
+    if path == "/debug/requests":
+        tls = flightrec.RECORDER.recent(
+            model=q("model"), limit=qint("limit", 64)
+        )
+        body = json.dumps({
+            "requests": [
+                t.to_dict(events=q("events", "1") not in ("0", "false"))
+                for t in tls
+            ],
+        })
+    elif path == "/debug/trace":
+        snap_id = qint("snapshot", 0)
+        if snap_id:
+            snaps = [
+                s for s in flightrec.RECORDER.snapshots()
+                if s["id"] == snap_id
+            ]
+            if not snaps:
+                # 404, not a 200-with-error body: `curl -f` scripts must
+                # not archive the miss as a valid trace capture
+                body = json.dumps({"error": "no such snapshot"})
+                status = 404
+            else:
+                # same renderer as the live path — a snapshot keeps its
+                # durations and engine-lane events through the freeze
+                body = json.dumps(flightrec.snapshot_trace(snaps[0]))
+        else:
+            model = q("model")
+            body = json.dumps(flightrec.chrome_trace(
+                flightrec.RECORDER.recent(
+                    model=model, limit=qint("limit", 64)
+                ),
+                flightrec.RECORDER.model_events(model),
+            ))
+    elif path == "/debug/spans":
+        spans = tracing.recent_spans(
+            name=q("name"), limit=qint("limit", 200)
+        )
+        body = json.dumps({
+            "spans": [
+                {
+                    "name": s.name, "trace_id": s.trace_id,
+                    "span_id": s.span_id, "parent_id": s.parent_id,
+                    "start": s.start, "duration_ms":
+                        round(s.duration_s * 1e3, 3),
+                    "status": s.status,
+                    "attributes": {
+                        k: repr(v) if not isinstance(
+                            v, (str, int, float, bool, type(None))
+                        ) else v
+                        for k, v in s.attributes.items()
+                    },
+                }
+                for s in spans
+            ],
+        })
+    elif path == "/debug/slo":
+        body = json.dumps({
+            "config": vars(slo.ENGINE.cfg),
+            "models": {
+                m: {
+                    "objectives": slo.ENGINE.evaluate(m),
+                    "tenants": slo.ENGINE.tenants(m),
+                }
+                for m in slo.ENGINE.models()
+            },
+        })
+    elif path == "/debug/snapshots":
+        snap_id = qint("id", 0)
+        snaps = flightrec.RECORDER.snapshots()
+        if snap_id:
+            match = [s for s in snaps if s["id"] == snap_id]
+            if match:
+                body = json.dumps(match[0])
+            else:
+                body = json.dumps({"error": "no such snapshot"})
+                status = 404
+        else:
+            body = json.dumps({
+                "snapshots": [
+                    {k: s[k] for k in ("id", "model", "cause", "at")}
+                    | {"timelines": len(s["timelines"])}
+                    for s in snaps
+                ],
+            })
+    else:
+        return None
+    return body.encode("utf-8"), "application/json", status
 
 
 def start_metrics_server(
@@ -36,22 +165,59 @@ def start_metrics_server(
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-            if self.path.split("?")[0] == "/metrics":
+            parsed = urlparse(self.path)
+            path = parsed.path
+            status = 200
+            if path == "/metrics":
                 body = reg.render().encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif self.path.split("?")[0] == "/healthz":
+            elif path == "/livez":
+                # pure liveness: always 200 while the process answers.
+                # Point k8s livenessProbe HERE — /healthz 503s on SLO
+                # breach, and a liveness probe acting on that would kill
+                # the process (losing AOT warmup + KV caches) in a
+                # restart loop exactly when the plane is overloaded;
+                # /healthz is for readiness / LB rotation decisions.
+                body = b'{"status":"alive"}'
+                ctype = "application/json"
+            elif path == "/healthz":
                 payload = {"status": "ok"}
                 if health_fn is not None:
                     try:
                         payload.update(health_fn())
                     except Exception as exc:  # noqa: BLE001
-                        payload = {"status": "degraded", "error": repr(exc)[:200]}
+                        payload = {"status": "degraded",
+                                   "error": repr(exc)[:200]}
+                # degraded/SLO-breach is a PROBE FAILURE, not prose: load
+                # balancers and k8s probes act on the status code, so a
+                # body saying "degraded" under HTTP 200 kept sick
+                # replicas in rotation (the ISSUE 8 satellite fix). A
+                # health_fn wanting SLO degradation folds it in via
+                # slo.annotate_health (the runtime service does).
+                if payload.get("status", "ok") != "ok":
+                    status = 503
                 body = json.dumps(payload).encode("utf-8")
                 ctype = "application/json"
+            elif path.startswith("/debug/"):
+                try:
+                    rendered = _debug_response(path, parse_qs(parsed.query))
+                except Exception as exc:  # noqa: BLE001 - debug routes
+                    # must never take down the exposition endpoint
+                    rendered = (
+                        json.dumps({"error": repr(exc)[:200]}).encode(
+                            "utf-8"
+                        ),
+                        "application/json",
+                        500,
+                    )
+                if rendered is None:
+                    self.send_error(404)
+                    return
+                body, ctype, status = rendered
             else:
                 self.send_error(404)
                 return
-            self.send_response(200)
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -236,6 +236,31 @@ PREFIX_HOST_RESTORE_SECONDS = Histogram(
              0.5, 1.0),
 )
 
+# -- SLO engine (obs/slo.py, fed by the obs/flightrec.py recorder) ---------
+# Labeled (model, objective) with ``objective`` drawn from the closed
+# slo.OBJECTIVES enum (ttft|tpot|availability). The per-tenant breakdown
+# deliberately stays in /debug/slo JSON — a tenant x model label product
+# would be unbounded (the test_serving_label_conventions rationale).
+
+SLO_ATTAINMENT = Gauge(
+    "aios_tpu_slo_attainment_ratio",
+    "Fraction of windowed requests meeting the objective's target "
+    "(objective=ttft|tpot|availability; scrape-time, sliding window)",
+    ("model", "objective"),
+)
+SLO_BURN_RATE = Gauge(
+    "aios_tpu_slo_burn_rate_ratio",
+    "Error-budget burn rate: (1 - attainment) / (1 - target); 1.0 burns "
+    "exactly at budget, >1 eats future budget (scrape-time)",
+    ("model", "objective"),
+)
+SLO_BREACHES = Counter(
+    "aios_tpu_slo_breaches_total",
+    "Windowed attainment fell below target (edge-triggered per "
+    "(model, objective); each breach freezes a flight-recorder snapshot)",
+    ("model", "objective"),
+)
+
 # -- runtime service -------------------------------------------------------
 
 RUNTIME_INFER_LATENCY = Histogram(

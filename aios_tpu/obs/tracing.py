@@ -87,9 +87,15 @@ def parse_traceparent(value: str) -> Optional[Tuple[str, str]]:
 
 def set_exporter(fn: Optional[Callable[[Span], None]]) -> None:
     """Attach a finished-span callback (None clears). The ring keeps
-    filling either way."""
+    filling either way. The default exporter (installed by the obs
+    package) feeds the flight recorder so finished RPC spans fold into
+    request timelines; deployments may replace it."""
     global _exporter
     _exporter = fn
+
+
+def get_exporter() -> Optional[Callable[[Span], None]]:
+    return _exporter
 
 
 def recent_spans(name: str = "", limit: int = 100) -> List[Span]:

@@ -24,7 +24,7 @@ from typing import Iterator, Optional
 import grpc
 
 from .. import rpc
-from ..obs import instruments as obs, tracing
+from ..obs import flightrec, instruments as obs, slo, tracing
 from ..obs.http import maybe_start_metrics_server
 from ..proto_gen import common_pb2, runtime_pb2
 from ..services import RUNTIME, AIRuntimeServicer, service_address
@@ -124,6 +124,16 @@ class RuntimeService(AIRuntimeServicer):
                 continue
             details[f"{m.name}.serving"] = ",".join(
                 f"{k}={v}" for k, v in sorted(stats.items())
+            )
+        # SLO view (obs/slo.py): per-objective windowed attainment, with
+        # breached objectives flagged — the gRPC twin of the /healthz
+        # degradation signal
+        for name in slo.ENGINE.models():
+            ev = slo.ENGINE.evaluate(name)
+            details[f"{name}.slo"] = ",".join(
+                f"{o}={v['attainment']:.4f}"
+                + ("!breach" if v["breached"] else "")
+                for o, v in sorted(ev.items())
             )
         ready = len(self.manager.ready_models())
         return common_pb2.HealthStatus(
@@ -294,6 +304,17 @@ class RuntimeService(AIRuntimeServicer):
         tenant = tenant_of(
             request, m.pool.cfg.tenant_by if m.pool is not None else "agent"
         )
+        # flight recorder: the timeline opens HERE — the first point that
+        # knows model, tenant, AND the RPC's trace identity (the server
+        # interceptor's span is current on this handler thread), so shed
+        # decisions, route choice, and scheduler events all land on one
+        # record correlated with the span tree by trace id
+        span = tracing.current_span()
+        req.rec = flightrec.RECORDER.begin(
+            m.name, req.request_id, tenant,
+            trace_id=span.trace_id if span is not None else "",
+            prompt_tokens=len(prompt_ids), priority=req.priority,
+        )
         deadline_s = None
         if context is not None:
             tr = context.time_remaining()
@@ -416,10 +437,14 @@ def serve(
     service.metrics_server, service.metrics_port = maybe_start_metrics_server(
         "runtime",
         metrics_port,
-        health_fn=lambda: {
+        # the SLO view rides the probe: any breached objective flips
+        # status to "degraded", which obs/http.py maps to HTTP 503 — so
+        # load balancers eject the replica instead of reading prose
+        health_fn=lambda: slo.annotate_health({
+            "status": "ok",
             "service": "runtime",
             "models_ready": len(service.manager.ready_models()),
-        },
+        }),
     )
     log.info("AIRuntime listening on %s", address)
     if block:

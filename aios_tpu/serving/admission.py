@@ -31,6 +31,7 @@ import time
 from typing import Dict, Optional
 
 from ..obs import instruments as obs
+from ..obs.flightrec import SHED_CAUSES
 from .config import ServingConfig
 
 # Bound the retry-after hint: past this, the client should re-resolve /
@@ -121,9 +122,12 @@ class AdmissionController:
         )
         self._buckets: Dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
+        # one closed enum end to end: the shed counter's label set, the
+        # AdmissionError causes, and the flight recorder's shed events
+        # all draw from obs.flightrec.SHED_CAUSES
         self._obs_shed = {
             cause: obs.SERVING_SHED.labels(model=model, cause=cause)
-            for cause in ("quota", "deadline", "queue_full", "draining")
+            for cause in SHED_CAUSES
         }
 
     def shed(self, cause: str, message: str, retry_after_ms: int = 1000,

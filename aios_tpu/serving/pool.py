@@ -28,6 +28,8 @@ import weakref
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..obs import instruments as obs
+from ..obs import flightrec
+from ..obs.flightrec import SHED_CAUSES
 from .admission import AdmissionController, AdmissionError
 from .config import ServingConfig
 from .router import Router
@@ -35,7 +37,6 @@ from .router import Router
 log = logging.getLogger("aios.serving")
 
 ROUTE_REASONS = ("prefix", "sticky", "least_loaded", "spill", "single")
-SHED_CAUSES = ("quota", "deadline", "queue_full", "draining")
 
 
 class Replica:
@@ -178,11 +179,26 @@ class ReplicaPool:
         """Admission -> routing -> replica submit. Raises
         :class:`AdmissionError` when the request is shed (the service
         maps it to RESOURCE_EXHAUSTED + retry-after-ms metadata)."""
+        # flight recorder: the runtime service opens the timeline with
+        # tenant + trace context; direct pool callers (tests, bench) get
+        # one here so every request through the front door is recorded
+        if getattr(req, "rec", None) is None:
+            req.rec = flightrec.RECORDER.begin(
+                self.name, req.request_id, tenant,
+                prompt_tokens=len(req.prompt_ids),
+                priority=getattr(req, "priority", 0),
+            )
         try:
             return self._submit(req, tenant, deadline_s)
         except AdmissionError as e:
             with self._lock:
                 self._shed[e.cause] = self._shed.get(e.cause, 0) + 1
+            # the shed IS the request's terminal event: record cause +
+            # retry-after and run spike detection (a shed storm freezes
+            # an anomaly snapshot even with the recorder disabled)
+            flightrec.RECORDER.finish_shed(
+                req.rec, e.cause, e.retry_after_ms, model=self.name
+            )
             raise
 
     def _submit(self, req, tenant: str, deadline_s: Optional[float]):
@@ -202,8 +218,11 @@ class ReplicaPool:
         if cap is not None and len(route_ids) > cap - 1:
             route_ids = route_ids[-(cap - 1):]
         hashes = self.replicas[0].prefix_hashes(route_ids)
+        rec = getattr(req, "rec", None)
+        route_detail: Dict[str, int] = {}
         idx, reason = self.router.select(
-            self.replicas, route_ids, req.request_id, hashes=hashes
+            self.replicas, route_ids, req.request_id, hashes=hashes,
+            detail=route_detail,
         )
         if (
             self.cfg.max_queue > 0
@@ -247,6 +266,19 @@ class ReplicaPool:
         # starve the tenant's feasible traffic). Cost = the work the pool
         # will actually do: truncated prompt + cache-capped decode.
         self.admission.check_quota(tenant, len(route_ids) + decode_cost)
+        if rec is not None:
+            rec.replica, rec.route_reason = idx, reason
+            rec.event("route", replica=idx, reason=reason, **route_detail)
+            # admission verdict AFTER the last gate that can shed: the
+            # admit event means every gate passed, with the evidence the
+            # gates judged (queue depth, decode budget, deadline)
+            rec.event(
+                "admit", replica=idx, queue_depth=r.queue_depth(),
+                outstanding_tokens=r.outstanding_tokens(),
+                decode_cost=decode_cost,
+                deadline_s=round(deadline_s, 3)
+                if deadline_s is not None else None,
+            )
         # capture BEFORE batcher.submit: it assigns an auto id to blank
         # request_ids, which must not enter the sticky map (auto ids are
         # per-batcher counters and collide across replicas)
@@ -280,6 +312,16 @@ class ReplicaPool:
                 r.batcher = self._spawn_batcher(r.engine)
                 self.restarts += 1
                 self._obs_restarts.inc()
+                # the crashed scheduler aborted every outstanding request
+                # — freeze the evidence (their timelines, with the abort
+                # causes) before the ring churns past it
+                flightrec.RECORDER.model_event(
+                    self.name, "respawn", replica=r.idx,
+                    error=repr(err)[:200],
+                )
+                flightrec.RECORDER.snapshot(
+                    self.name, "crash_respawn", sync=False  # submit path
+                )
                 if self.on_respawn is not None:
                     self.on_respawn(r.idx, r.batcher)
 

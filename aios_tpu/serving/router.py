@@ -45,13 +45,17 @@ class Router:
 
     def select(self, replicas: Sequence, prompt_ids: List[int],
                task_id: str = "",
-               hashes: Optional[List[bytes]] = None) -> Tuple[int, str]:
+               hashes: Optional[List[bytes]] = None,
+               detail: Optional[dict] = None) -> Tuple[int, str]:
         """Pick a replica index for a request. ``replicas`` are
         Replica-shaped objects (``overlap_rows(ids, hashes=None)``,
         ``outstanding_tokens()``); returns (index, reason). ``hashes``
         are the prompt's precomputed block digests (the ``bytes`` sha256
         chain of ``paged.chain_hashes``) — the pool hashes once so N
-        replicas don't each redo the sha256 chain."""
+        replicas don't each redo the sha256 chain. A caller-supplied
+        ``detail`` dict receives the decision's evidence (best overlap
+        rows — host-discounted rows included, per the replica's probe —
+        and the threshold it was held to) for the flight recorder."""
         if len(replicas) == 1:
             return 0, "single"
         sticky = self._sticky_for(task_id, len(replicas))
@@ -63,6 +67,9 @@ class Router:
             if rows > best_rows:
                 best, best_rows = i, rows
         threshold = max(1, int(len(prompt_ids) * self.overlap_min_ratio))
+        if detail is not None:
+            detail["overlap_rows"] = best_rows
+            detail["overlap_threshold"] = threshold
         if best >= 0 and best_rows >= threshold:
             return best, "prefix"
         return self.least_loaded(replicas), "least_loaded"

@@ -59,6 +59,39 @@ def emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def slo_block(model: str) -> dict:
+    """TTFT/TPOT p50/p99 + windowed SLO attainment for one model, read
+    from the flight-recorder ring and the SLO engine — the per-bench
+    serving-quality block (ISSUE 8). Benches that route requests through
+    a ContinuousBatcher / ReplicaPool attach this to their JSON line so
+    every capture doubles as an SLO regression record."""
+    from aios_tpu.obs import flightrec, slo
+
+    tls = flightrec.RECORDER.recent(model=model, limit=512)
+    ttfts = sorted(t.ttft_ms for t in tls if t.ttft_ms > 0)
+    tpots = sorted(t.tpot_ms for t in tls if t.tpot_ms > 0)
+
+    def pct(vals, p):
+        if not vals:
+            return 0.0
+        idx = min(int(p * (len(vals) - 1) + 0.5), len(vals) - 1)
+        return round(vals[idx], 3)
+
+    block = {
+        "requests": len(tls),
+        "ttft_p50_ms": pct(ttfts, 0.5),
+        "ttft_p99_ms": pct(ttfts, 0.99),
+        "tpot_p50_ms": pct(tpots, 0.5),
+        "tpot_p99_ms": pct(tpots, 0.99),
+    }
+    if model in slo.ENGINE.models():
+        block["attainment"] = {
+            objective: v["attainment"]
+            for objective, v in slo.ENGINE.evaluate(model).items()
+        }
+    return block
+
+
 def probe_backend(window_secs: float | None = None,
                   max_attempts: int | None = None) -> bool:
     """Probe backend init in a subprocess with capped backoff, so a
@@ -455,6 +488,7 @@ def bench_replica_pool(replicas: int):
                 stats.get(f"replica{i}_occupancy", 0.0)
                 for i in range(replicas)
             ],
+            "slo": slo_block("bench-pool"),
         }
     finally:
         pool.shutdown()
@@ -675,6 +709,70 @@ def bench_host_tier():
     }
 
 
+def bench_flight_dump():
+    """Flight-recorder smoke (--flight-dump): serve a greedy wave
+    through a tiny 2-replica pool, then verify the full observability
+    round trip — per-request timelines in the ring, Chrome trace-event
+    JSON rendering/parsing, SLO summary — without a single assertion
+    (exit 0 always; the cheap regression probe for the recorder path,
+    the --host-tier-smoke pattern)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.obs import flightrec
+    from aios_tpu.serving import ReplicaPool, ServingConfig
+
+    cfg = TINY_TEST.scaled(name="flight-dump", max_context=256)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    engines = [
+        TPUEngine(cfg, params, num_slots=2, max_context=256,
+                  cache_dtype=jnp.float32)
+        for _ in range(2)
+    ]
+    pool = ReplicaPool(
+        "flight-dump", engines, lambda e: ContinuousBatcher(e),
+        ServingConfig(replicas=2),
+    )
+    try:
+        handles = [
+            pool.submit(
+                Request(prompt_ids=[3 + i, 7, 11], max_tokens=12,
+                        temperature=0.0),
+                tenant=f"tenant-{i % 2}",
+            )
+            for i in range(6)
+        ]
+        for h in handles:
+            h.tokens()
+    finally:
+        pool.shutdown()
+    tls = flightrec.RECORDER.recent(model="flight-dump", limit=64)
+    trace = flightrec.chrome_trace(
+        tls, flightrec.RECORDER.model_events("flight-dump")
+    )
+    parsed = json.loads(json.dumps(trace))  # the round trip under test
+    kinds = sorted({k for t in tls for _, k, _ in t.events})
+    states = sorted({t.state for t in tls})
+    log(f"[flight-dump] {len(tls)} timelines, "
+        f"{len(parsed['traceEvents'])} trace events, kinds={kinds}")
+    return {
+        "metric": "flight recorder smoke (2-replica pool wave -> "
+                  "timeline ring -> Chrome trace JSON)",
+        "value": float(len(tls)),
+        "unit": "timelines recorded",
+        "vs_baseline": 1.0,
+        "trace_events": len(parsed["traceEvents"]),
+        "event_kinds": kinds,
+        "states": states,
+        "slo": slo_block("flight-dump"),
+    }
+
+
 def bench_dispatch():
     """Pipelined-decode A/B through the production continuous batcher
     (AIOS_TPU_DECODE_PIPELINE): 8 concurrent greedy requests per wave,
@@ -778,6 +876,7 @@ def bench_dispatch():
         "host_gap_ms_on": round(gaps[True], 3),
         "pipeline_flushes": int(flushes),
         "tokens_identical": bool(identical),
+        "slo": slo_block("micro-dispatch"),
         # this container: 2 shared cores, XLA's compute threads saturate
         # both, and the scheduler's host phase is ~2 ms against 20+ ms
         # dispatches — the structural ceiling for overlap here is ~10%.
@@ -1305,7 +1404,23 @@ def main() -> int:
                          "spill->restore exercise (assertion-free, CPU "
                          "fallback fine, always exit 0) — the cheap "
                          "regression probe for the host spill tier")
+    ap.add_argument("--flight-dump", action="store_true",
+                    help="run ONLY the flight-recorder smoke: a tiny "
+                         "2-replica pool wave whose request timelines "
+                         "are dumped as Chrome trace JSON + SLO summary "
+                         "(assertion-free, always exit 0)")
     args = ap.parse_args()
+
+    if args.flight_dump:
+        try:
+            emit(bench_flight_dump())
+        except Exception as e:  # assertion-free: diagnose, never fail
+            log(f"[flight-dump] FAILED: {e!r}")
+            emit({"metric": "flight recorder smoke (2-replica pool wave "
+                            "-> timeline ring -> Chrome trace JSON)",
+                  "value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
+                  "error": repr(e)[:300]})
+        return 0
 
     if args.host_tier_smoke:
         try:

@@ -254,6 +254,146 @@ def test_engine_dispatch_flush_causes_bounded():
     assert causes <= {"constrained", "spec", "evict", "idle"}
 
 
+# -- the SLO family (obs/slo.py, fed by the flight recorder, ISSUE 8) ------
+
+SLO_EXPECTED = {
+    "aios_tpu_slo_attainment_ratio": "gauge",
+    "aios_tpu_slo_burn_rate_ratio": "gauge",
+    "aios_tpu_slo_breaches_total": "counter",
+}
+
+
+def test_slo_family_complete_and_typed():
+    """The SLO instruments the ISSUE 8 catalog promises exist, with the
+    promised kinds — and any NEW aios_tpu_slo_* metric must be added
+    here (and to docs/OBSERVABILITY.md) so the family stays reviewed.
+    Labels are exactly (model, objective): the per-tenant breakdown
+    stays in /debug/slo JSON because a tenant x model label product is
+    unbounded (the test_serving_label_conventions rationale)."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_slo_")
+    }
+    assert family == SLO_EXPECTED
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_slo_"):
+            assert tuple(m.labelnames) == ("model", "objective"), (
+                f"{m.name}: SLO metrics carry exactly (model, objective)"
+            )
+
+
+def test_slo_objectives_are_a_closed_enum():
+    """The ``objective`` label values come from slo.OBJECTIVES and
+    nowhere else — the gauge registrations iterate the tuple, so a new
+    objective is a reviewed enum change, not a stray string."""
+    import inspect
+
+    from aios_tpu.obs import slo
+
+    assert slo.OBJECTIVES == ("ttft", "tpot", "availability")
+    src = inspect.getsource(slo.SLOEngine._register_gauges)
+    assert "OBJECTIVES" in src, (
+        "SLO gauge children must be registered by iterating the "
+        "OBJECTIVES enum"
+    )
+
+
+# -- flight-recorder closed enums (obs/flightrec.py, ISSUE 8) --------------
+# The bounded-flush-cause pattern (ISSUE 6), extended: every event kind,
+# shed cause, and abort cause the recorder can emit comes from ONE shared
+# closed enum, so neither the recorder output nor any aios_tpu_slo_* /
+# aios_tpu_serving_* label built on it can grow free-form label sets.
+
+
+def _call_site_kinds(*modules):
+    """Event kinds used at ``.event("<kind>", ...)`` /
+    ``.model_event(<model>, "<kind>", ...)`` call sites in the given
+    modules' sources."""
+    import inspect
+
+    kinds = set()
+    for mod in modules:
+        src = inspect.getsource(mod)
+        kinds |= set(re.findall(r'\.event\(\s*"([a-z_]+)"', src))
+        kinds |= set(
+            re.findall(r'\.model_event\(\s*[^,]+,\s*"([a-z_]+)"', src)
+        )
+    return kinds
+
+
+def test_recorder_event_kinds_bounded():
+    """Every event-kind string at every recorder call site — batcher,
+    pool, engine, runtime service, and flightrec itself — is a member of
+    the closed flightrec.EVENT_KINDS enum."""
+    from aios_tpu.engine import batching, engine as engine_mod
+    from aios_tpu.obs import flightrec
+    from aios_tpu.runtime import service as runtime_service
+    from aios_tpu.serving import pool
+
+    kinds = _call_site_kinds(
+        batching, engine_mod, pool, runtime_service, flightrec
+    )
+    assert kinds, "no recorder event call sites found"
+    unknown = kinds - set(flightrec.EVENT_KINDS)
+    assert not unknown, (
+        f"event kinds {sorted(unknown)} not in the closed EVENT_KINDS "
+        f"enum — extend the enum (reviewed) instead of inventing strings"
+    )
+
+
+def test_shed_causes_one_shared_enum():
+    """Admission, the pool's shed tallies, and the recorder's shed
+    events all draw from the SAME tuple object —
+    obs.flightrec.SHED_CAUSES — so the aios_tpu_serving_shed_total label
+    set and the timeline shed_cause field cannot drift apart."""
+    import inspect
+
+    from aios_tpu.obs import flightrec
+    from aios_tpu.serving import admission, pool
+
+    assert pool.SHED_CAUSES is flightrec.SHED_CAUSES
+    assert admission.SHED_CAUSES is flightrec.SHED_CAUSES
+    src = inspect.getsource(admission.AdmissionController.__init__)
+    assert "SHED_CAUSES" in src, (
+        "the shed-counter children must be built from the shared enum"
+    )
+    # every cause raised anywhere must be a member
+    causes = set(
+        re.findall(r'self\.shed\(\s*\n?\s*"([a-z_]+)"',
+                   inspect.getsource(admission))
+    ) | set(
+        re.findall(r'admission\.shed\(\s*\n?\s*"([a-z_]+)"',
+                   inspect.getsource(pool))
+    )
+    assert causes, "no shed call sites found"
+    assert causes <= set(flightrec.SHED_CAUSES)
+
+
+def test_abort_reasons_normalize_onto_closed_enum():
+    """Every abort_reason string the batcher can set maps to a
+    NON-'other' member of flightrec.ABORT_CAUSES — a new abort path must
+    extend the mapping (reviewed), or its timelines and SLO samples
+    degrade to the catch-all bucket."""
+    import inspect
+
+    from aios_tpu.engine import batching
+    from aios_tpu.obs import flightrec
+
+    src = inspect.getsource(batching)
+    literals = set(re.findall(r'abort_reason\s*=\s*"([^"]+)"', src))
+    literals |= set(
+        re.findall(r'_terminate_outstanding\(\s*f?"([^"{]+)', src)
+    )
+    assert literals, "no abort_reason literals found in the batcher"
+    for reason in literals:
+        cause = flightrec.abort_cause(reason)
+        assert cause in flightrec.ABORT_CAUSES
+        assert cause != "other", (
+            f"abort_reason {reason!r} falls into the catch-all bucket; "
+            f"extend flightrec.abort_cause/ABORT_CAUSES"
+        )
+
+
 def test_serving_label_conventions():
     """Serving labels stay low-cardinality by construction: routing
     reasons and shed causes are fixed enums (see serving/pool.py); only
