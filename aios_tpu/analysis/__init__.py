@@ -1,0 +1,23 @@
+"""Concurrency & dispatch-discipline analysis for the serving plane.
+
+Static half: an AST rule engine (``python -m aios_tpu.analysis``, also a
+tier-1 test) that machine-checks the invariants previous PRs enforced by
+reviewer vigilance — no dispatch/readback/blocking-RPC under the
+declared locks, an acyclic lock-order graph, ``guarded_by`` field
+discipline, jit-behind-warmup dispatch hygiene, and env-knob/metric
+catalog drift. Rule catalog and waiver policy: docs/ANALYSIS.md.
+
+Runtime half: :mod:`aios_tpu.analysis.locks` — named, order-checking
+debug locks the declared serving-plane locks switch to under
+``AIOS_TPU_LOCK_DEBUG=1`` (the test suite runs with it on).
+
+Import note: this package must stay import-light (no jax, no obs) — the
+engine imports ``locks.make_lock`` at module import time.
+"""
+
+from .core import Finding, ModuleInfo, module_info_for  # noqa: F401
+from .locks import (  # noqa: F401
+    DebugLock, LockOrderError, debug_enabled, make_lock, watchdog_trips,
+)
+from .registry import DEFAULT, LOCKS, Registry  # noqa: F401
+from .rules import RULE_IDS, Analyzer, run_analysis  # noqa: F401

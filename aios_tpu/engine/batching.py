@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.locks import make_lock
 from .engine import (
     JUMP_BUCKETS, ChunkedPrefill, PendingDecode, TPUEngine, _env_flag,
 )
@@ -213,7 +214,7 @@ class ContinuousBatcher:
         self._mask_base = None  # cached all-zeros [slots, vocab] device mask
         self.tokenizer = tokenizer
         self._json_masks = None  # lazy jsonmode.JsonMaskCache
-        self._json_masks_lock = threading.Lock()
+        self._json_masks_lock = make_lock("json_masks")
         self._token_table = None  # shared token->bytes table
         self._byte_matrix = None  # shared (mat, lens) across mask caches
         from collections import OrderedDict
@@ -306,16 +307,16 @@ class ContinuousBatcher:
         self.pool_evictions = 0
         self.cancellations = 0
         self._closed = False  # set by shutdown(); submit() refuses after
-        self._waiting: "deque[_Live]" = deque()
-        self._qlock = threading.Lock()
+        self._waiting: "deque[_Live]" = deque()  #: guarded_by _qlock
+        self._qlock = make_lock("batcher_queue")
         self._prefilling: Optional[Tuple[_Live, ChunkedPrefill]] = None
         self._prefill_chunks = 0  # chunks of the in-flight admission
         self._reserved_slot = -1  # slot mid-chunked-prefill (not yet active)
-        self._live: Dict[int, _Live] = {}  # slot -> request
+        self._live: Dict[int, _Live] = {}  #: guarded_by _lock
         self._wake = threading.Event()
         self._stop = False
         self._ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = make_lock("batcher")
         self.completed = 0
         self.last_error: Optional[BaseException] = None
         # If the engine went through its warmup gate, make sure OUR dispatch

@@ -36,6 +36,7 @@ import numpy as np
 
 from . import model, paged, sampling, spec
 from .config import ModelConfig
+from ..analysis.locks import make_lock
 from ..obs import instruments as obs
 from ..obs import flightrec
 
@@ -297,7 +298,7 @@ class TPUEngine:
         self.buckets = tuple(
             b for b in DEFAULT_BUCKETS if b <= self.max_context
         ) or (self.max_context,)
-        self._lock = threading.Lock()
+        self._lock = make_lock("engine")
         self.plan = shardings
         # normalize the quantize knob to a mode: True -> int8 (the measured
         # single-chip default), "int4" -> packed-nibble group-wise int4
@@ -714,8 +715,8 @@ class TPUEngine:
             # pending-page counter shared by the engine thread (raise) and
             # the worker (lower) — int += is a read-modify-write, NOT
             # GIL-atomic, so it gets its own tiny lock
-            self._spill_pending = 0
-            self._spill_lock = threading.Lock()
+            self._spill_pending = 0  #: guarded_by _spill_lock
+            self._spill_lock = make_lock("engine_spill")
             self._spill_max_pending = max(
                 16, self.allocator.capacity_blocks()
             )
@@ -1822,11 +1823,13 @@ class TPUEngine:
             )
             return
         try:
+            # aios: waive(lock-readback): host-side page-id list, no device sync
             pages = np.asarray([p for _, p in evicted], np.int32)
             arrs = [self.state["k"][:, pages], self.state["v"][:, pages]]
             if self.quant_cache:
                 arrs.append(self.state["k_s"][:, pages])
                 arrs.append(self.state["v_s"][:, pages])
+            # aios: waive(lock-readback): PR-4 contract — the gather must materialize under the engine lock; the evicted pages free (and can be rewritten by the next donated dispatch) the moment this hook returns
             jax.block_until_ready(arrs)
         except BaseException:
             # a failed gather (e.g. RESOURCE_EXHAUSTED materializing the
@@ -2434,16 +2437,25 @@ class TPUEngine:
             )(self.params, self.state, *args)
             self.decode_steps += n_rounds
             self._obs_decode_steps.inc(n_rounds)
-            counts = np.asarray(counts)
             self.spec_rounds += n_rounds
-            self.spec_tokens += int(counts[:, self.active].sum())
             # acceptance denominator: (round, active-slot) pairs — a
             # per-slot rate that doesn't scale with batch occupancy
             self.spec_slot_rounds += n_rounds * int(self.active.sum())
+        # the device->host readback happens OUTSIDE the engine lock
+        # (the step()/step_masked() discipline, lock-readback rule):
+        # concurrent peek/stats callers must not wait on the transfer
+        counts = np.asarray(counts)
+        tokens = np.asarray(tokens)
+        # fold the data-dependent length advance back in under the lock;
+        # dispatches all come from the scheduler thread (spec ticks flush
+        # the pipeline first), so nothing interleaves between the two
+        # critical sections
+        with self._lock:
+            self.spec_tokens += int(counts[:, self.active].sum())
             self._host_lengths = np.minimum(
                 self._host_lengths + counts.sum(axis=0), self.max_context - 1
             )
-            return np.asarray(tokens), counts
+        return tokens, counts
 
     def release(self, slot: int) -> None:
         self.active[slot] = False

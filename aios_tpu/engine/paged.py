@@ -27,6 +27,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.locks import make_lock
+
 log = logging.getLogger("aios.paged")
 
 SACRIFICIAL_PAGE = 0
@@ -295,18 +297,17 @@ class HostPageStore:
     either."""
 
     def __init__(self, max_bytes: int) -> None:
-        import threading
-
         self.max_bytes = int(max_bytes)
+        #: guarded_by _lock
         self._entries: "OrderedDict[bytes, Dict[str, np.ndarray]]" = (
             OrderedDict()
         )
-        self.bytes_resident = 0
+        self.bytes_resident = 0  #: guarded_by _lock
         self.spills = 0  # entries accepted from HBM evictions
         self.restores = 0  # entries promoted back into pool pages
         self.hits = 0  # restore probes that found >= 1 entry
         self.misses = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("host_store")
 
     @staticmethod
     def _entry_bytes(entry: Dict[str, np.ndarray]) -> int:
@@ -406,8 +407,6 @@ class _PrefixIndexBase:
             raise ValueError(
                 "prefix indexes require an unreplicated pool (replicas=1)"
             )
-        import threading
-
         self.alloc = allocator
         self.max_pages = max_pages
         self.hits = 0
@@ -418,7 +417,7 @@ class _PrefixIndexBase:
         self.spill: Optional[
             Callable[[List[Tuple[bytes, int]]], None]
         ] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("prefix_index")
         allocator.reclaimer = self.reclaim
 
     def reclaim(self, n: int) -> int:  # pragma: no cover - abstract

@@ -43,6 +43,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.locks import make_lock
+
 log = logging.getLogger("aios.obs")
 
 # -- closed enums (linted by tests/test_obs_lint.py) ------------------------
@@ -221,9 +223,9 @@ class FlightRecorder:
             ).lower() not in ("0", "off", "false", "no")
         self.ring_size = max(ring, 1)
         self.enabled = enabled and ring != 0
-        self._lock = threading.Lock()
-        self._rings: Dict[str, deque] = {}
-        self._model_events: Dict[str, deque] = {}
+        self._lock = make_lock("recorder")
+        self._rings: Dict[str, deque] = {}  #: guarded_by _lock
+        self._model_events: Dict[str, deque] = {}  #: guarded_by _lock
         # trace_id -> recent timelines sharing it: an agent task's RPCs
         # all propagate ONE traceparent, so a single-slot map would make
         # every begin() steal the previous request's span correlation

@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -41,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 from . import flightrec
 from . import instruments as obs
+from ..analysis.locks import make_lock
 
 log = logging.getLogger("aios.obs")
 
@@ -104,9 +104,9 @@ _Sample = Tuple[float, str, Optional[float], Optional[float], bool]
 class SLOEngine:
     def __init__(self, cfg: Optional[SLOConfig] = None) -> None:
         self.cfg = cfg or SLOConfig.from_env()
-        self._lock = threading.Lock()
-        self._samples: Dict[str, deque] = {}
-        self._breached: Dict[Tuple[str, str], bool] = {}
+        self._lock = make_lock("slo")
+        self._samples: Dict[str, deque] = {}  #: guarded_by _lock
+        self._breached: Dict[Tuple[str, str], bool] = {}  #: guarded_by _lock
         self.breaches = 0  # total breach EDGES (monotonic)
         self._eval_cache: Dict[str, Tuple[float, dict]] = {}
         self._registered: set = set()

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Union
 import jax
 import jax.numpy as jnp
 
+from ..analysis.locks import make_lock
 from ..engine import gguf as gguf_mod
 from ..engine import model as model_mod
 from ..engine import weights as weights_mod
@@ -333,7 +334,7 @@ class ModelManager:
         self.seq_shard_kv = sharding_plan is not None and os.environ.get(
             "AIOS_TPU_SEQ_SHARD_KV", ""
         ).lower() in ("1", "true", "on")
-        self._lock = threading.Lock()
+        self._lock = make_lock("model_manager")
 
     @staticmethod
     def _kv_row_bytes(cfg, cache_dtype) -> float:

@@ -26,10 +26,10 @@ metadata hint — clients back off instead of hammering a saturated pool.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from ..analysis.locks import make_lock
 from ..obs import instruments as obs
 from ..obs.flightrec import SHED_CAUSES
 from .config import ServingConfig
@@ -82,9 +82,9 @@ class TokenBucket:
     def __init__(self, rate: float, burst: float) -> None:
         self.rate = rate
         self.burst = burst
-        self.tokens = burst
+        self.tokens = burst  #: guarded_by _lock
         self._at = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = make_lock("token_bucket")
 
     def try_take(self, cost: float) -> float:
         """Take ``cost`` tokens; returns 0.0 on success, else the seconds
@@ -120,8 +120,8 @@ class AdmissionController:
             if cfg.tenant_burst_tokens > 0
             else 4.0 * cfg.tenant_tokens_per_sec
         )
-        self._buckets: Dict[str, TokenBucket] = {}
-        self._lock = threading.Lock()
+        self._buckets: Dict[str, TokenBucket] = {}  #: guarded_by _lock
+        self._lock = make_lock("admission")
         # one closed enum end to end: the shed counter's label set, the
         # AdmissionError causes, and the flight recorder's shed events
         # all draw from obs.flightrec.SHED_CAUSES

@@ -27,6 +27,7 @@ import time
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..analysis.locks import make_lock
 from ..obs import instruments as obs
 from ..obs import flightrec
 from ..obs.flightrec import SHED_CAUSES
@@ -118,8 +119,10 @@ class ReplicaPool:
         self.on_respawn: Optional[Callable] = None
         self._draining = False
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("pool")
+        #: guarded_by _lock
         self._routed: Dict[str, int] = {r: 0 for r in ROUTE_REASONS}
+        #: guarded_by _lock
         self._shed: Dict[str, int] = {c: 0 for c in SHED_CAUSES}
         self._obs_routed = {
             r: obs.SERVING_ROUTING_DECISIONS.labels(model=name, reason=r)

@@ -30,8 +30,9 @@ gate sheds.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
+
+from ..analysis.locks import make_lock
 from typing import List, Optional, Sequence, Tuple
 
 _STICKY_CAPACITY = 4096  # task ids are client input; LRU-bound the map
@@ -40,8 +41,8 @@ _STICKY_CAPACITY = 4096  # task ids are client input; LRU-bound the map
 class Router:
     def __init__(self, overlap_min_ratio: float = 0.25) -> None:
         self.overlap_min_ratio = overlap_min_ratio
-        self._sticky: "OrderedDict[str, int]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._sticky: "OrderedDict[str, int]" = OrderedDict()  #: guarded_by _lock
+        self._lock = make_lock("router")
 
     def select(self, replicas: Sequence, prompt_ids: List[int],
                task_id: str = "",

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Static concurrency & dispatch-discipline analysis over aios_tpu/.
+#
+# Thin wrapper so CI jobs, pre-push hooks, and humans all invoke the ONE
+# entry point the tier-1 test uses (tests/test_analysis.py calls
+# aios_tpu.analysis.__main__.main directly — local runs and CI cannot
+# diverge). Exit 1 on any unwaived finding.
+#
+# Usage:
+#   scripts/analyze.sh                  # human-readable report
+#   scripts/analyze.sh --json          # machine-readable findings
+#   scripts/analyze.sh --rule lock-order --rule guarded-by
+#   scripts/analyze.sh --waived        # show waived findings + reasons
+#
+# Rule catalog, lock registry, and waiver policy: docs/ANALYSIS.md
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m aios_tpu.analysis "$@"

@@ -13,6 +13,14 @@ import os
 # virtual 8-device CPU mesh — the driver benches on TPU separately. Both the
 # env var and the config override are needed, before backends initialize.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Dynamic lock-order verification: every declared serving-plane lock
+# (aios_tpu/analysis/registry.py) becomes a named, order-checking
+# DebugLock, so the e2e tests double as deadlock detection — an AB/BA
+# acquisition inversion raises LockOrderError with both stacks instead
+# of hanging a run someday. setdefault: AIOS_TPU_LOCK_DEBUG=0 in the
+# environment turns it off for A/B timing comparisons.
+os.environ.setdefault("AIOS_TPU_LOCK_DEBUG", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -169,16 +169,18 @@ def test_engine_jump_ahead_family_complete_and_typed():
 
 def test_engine_jump_ahead_gauges_aggregate_over_engine_weakset():
     """The scrape callbacks must SUM over _ENGINES_BY_MODEL — a bare
-    weakref.ref(self) registration would report only the last replica."""
-    import inspect
-
+    weakref.ref(self) registration would report only the last replica.
+    Checked on the AST (analysis.core walker), not a source grep."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
     from aios_tpu.engine import engine as engine_mod
 
-    src = inspect.getsource(engine_mod.TPUEngine._register_gauges)
-    assert "_ENGINES_BY_MODEL" in src
+    mi = module_info_for(engine_mod)
+    fn = mi.functions["TPUEngine._register_gauges"]
+    used = names_used_in(fn.node)
+    assert "_ENGINES_BY_MODEL" in used
     for name in ("ENGINE_JUMP_DISPATCHES", "ENGINE_JUMP_TOKENS",
                  "SPEC_ROUNDS", "SPEC_ACCEPTED"):
-        assert name in src, f"{name} not registered over the WeakSet"
+        assert name in used, f"{name} not registered over the WeakSet"
 
 
 # -- the speculative-decode family (engine.spec_step + batcher EWMA) -------
@@ -241,15 +243,14 @@ def test_engine_dispatch_family_complete_and_typed():
 def test_engine_dispatch_flush_causes_bounded():
     """Flush causes are a fixed enum (see ContinuousBatcher
     _flush_pending call sites) — the label must never grow a per-request
-    or per-slot dimension."""
-    import inspect
-
+    or per-slot dimension. Call sites are enumerated on the AST."""
+    from aios_tpu.analysis.core import module_info_for, string_call_args
     from aios_tpu.engine import batching
 
-    causes = set(
-        re.findall(r'_flush_pending\("([a-z_]+)"\)',
-                   inspect.getsource(batching))
-    )
+    mi = module_info_for(batching)
+    causes = {
+        lit for lit, _ in string_call_args(mi.tree, ("_flush_pending",))
+    }
     assert causes, "no _flush_pending call sites found"
     assert causes <= {"constrained", "spec", "evict", "idle"}
 
@@ -286,13 +287,13 @@ def test_slo_objectives_are_a_closed_enum():
     """The ``objective`` label values come from slo.OBJECTIVES and
     nowhere else — the gauge registrations iterate the tuple, so a new
     objective is a reviewed enum change, not a stray string."""
-    import inspect
-
+    from aios_tpu.analysis.core import module_info_for, names_used_in
     from aios_tpu.obs import slo
 
     assert slo.OBJECTIVES == ("ttft", "tpot", "availability")
-    src = inspect.getsource(slo.SLOEngine._register_gauges)
-    assert "OBJECTIVES" in src, (
+    mi = module_info_for(slo)
+    fn = mi.functions["SLOEngine._register_gauges"]
+    assert "OBJECTIVES" in names_used_in(fn.node), (
         "SLO gauge children must be registered by iterating the "
         "OBJECTIVES enum"
     )
@@ -308,16 +309,20 @@ def test_slo_objectives_are_a_closed_enum():
 def _call_site_kinds(*modules):
     """Event kinds used at ``.event("<kind>", ...)`` /
     ``.model_event(<model>, "<kind>", ...)`` call sites in the given
-    modules' sources."""
-    import inspect
+    modules — AST call-argument extraction via the analysis walker, so
+    wrapped lines and keyword noise can't hide a call site the way they
+    could from the old regexes."""
+    from aios_tpu.analysis.core import module_info_for, string_call_args
 
     kinds = set()
     for mod in modules:
-        src = inspect.getsource(mod)
-        kinds |= set(re.findall(r'\.event\(\s*"([a-z_]+)"', src))
-        kinds |= set(
-            re.findall(r'\.model_event\(\s*[^,]+,\s*"([a-z_]+)"', src)
-        )
+        mi = module_info_for(mod)
+        kinds |= {
+            lit for lit, _ in string_call_args(mi.tree, ("event",), 0)
+        }
+        kinds |= {
+            lit for lit, _ in string_call_args(mi.tree, ("model_event",), 1)
+        }
     return kinds
 
 
@@ -346,25 +351,27 @@ def test_shed_causes_one_shared_enum():
     events all draw from the SAME tuple object —
     obs.flightrec.SHED_CAUSES — so the aios_tpu_serving_shed_total label
     set and the timeline shed_cause field cannot drift apart."""
-    import inspect
-
+    from aios_tpu.analysis.core import (
+        module_info_for, names_used_in, string_call_args,
+    )
     from aios_tpu.obs import flightrec
     from aios_tpu.serving import admission, pool
 
     assert pool.SHED_CAUSES is flightrec.SHED_CAUSES
     assert admission.SHED_CAUSES is flightrec.SHED_CAUSES
-    src = inspect.getsource(admission.AdmissionController.__init__)
-    assert "SHED_CAUSES" in src, (
+    adm_mi = module_info_for(admission)
+    init = adm_mi.functions["AdmissionController.__init__"]
+    assert "SHED_CAUSES" in names_used_in(init.node), (
         "the shed-counter children must be built from the shared enum"
     )
-    # every cause raised anywhere must be a member
-    causes = set(
-        re.findall(r'self\.shed\(\s*\n?\s*"([a-z_]+)"',
-                   inspect.getsource(admission))
-    ) | set(
-        re.findall(r'admission\.shed\(\s*\n?\s*"([a-z_]+)"',
-                   inspect.getsource(pool))
-    )
+    # every cause raised anywhere must be a member (`.shed("<cause>", ...)`
+    # call sites in admission AND pool, via the shared AST walker)
+    pool_mi = module_info_for(pool)
+    causes = {
+        lit
+        for mi in (adm_mi, pool_mi)
+        for lit, _ in string_call_args(mi.tree, ("shed",), 0)
+    }
     assert causes, "no shed call sites found"
     assert causes <= set(flightrec.SHED_CAUSES)
 
@@ -374,16 +381,19 @@ def test_abort_reasons_normalize_onto_closed_enum():
     NON-'other' member of flightrec.ABORT_CAUSES — a new abort path must
     extend the mapping (reviewed), or its timelines and SLO samples
     degrade to the catch-all bucket."""
-    import inspect
-
+    from aios_tpu.analysis.core import (
+        assigned_string_literals, call_string_heads, module_info_for,
+    )
     from aios_tpu.engine import batching
     from aios_tpu.obs import flightrec
 
-    src = inspect.getsource(batching)
-    literals = set(re.findall(r'abort_reason\s*=\s*"([^"]+)"', src))
-    literals |= set(
-        re.findall(r'_terminate_outstanding\(\s*f?"([^"{]+)', src)
-    )
+    mi = module_info_for(batching)
+    literals = {
+        lit for lit, _ in assigned_string_literals(mi.tree, "abort_reason")
+    }
+    literals |= {
+        lit for lit, _ in call_string_heads(mi.tree, "_terminate_outstanding")
+    }
     assert literals, "no abort_reason literals found in the batcher"
     for reason in literals:
         cause = flightrec.abort_cause(reason)
