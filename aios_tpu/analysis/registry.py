@@ -73,6 +73,9 @@ LOCKS: Tuple[LockDecl, ...] = (
     LockDecl("slo", "aios_tpu.obs.slo", "SLOEngine", "_lock"),
     LockDecl("model_manager", "aios_tpu.runtime.model_manager",
              "ModelManager", "_lock"),
+    LockDecl("faults", "aios_tpu.faults.inject", "FaultPlan", "_lock"),
+    LockDecl("failover", "aios_tpu.serving.failover", "FailoverHandle",
+             "_lock"),
 )
 
 
@@ -179,6 +182,32 @@ DISPATCH_HYGIENE_MODULES: Tuple[str, ...] = (
 WARMUP_ROOT_RE = re.compile(r"^(warmup|_compile_aot|compile_\w+)$")
 
 
+# -- silent-except (rule silent-except) -------------------------------------
+# Broad `except Exception` / `except BaseException` / bare `except:`
+# handlers in these module prefixes must RECORD the failure — re-raise,
+# log it, or land an abort/terminal cause — or carry an
+# `# aios: waive(silent-except): <reason>` pragma. Fault paths are the
+# least-exercised code in the tree; one that swallows its evidence is an
+# observability black hole exactly when the operator needs it most.
+
+SILENT_EXCEPT_PREFIXES: Tuple[str, ...] = (
+    "aios_tpu.serving", "aios_tpu.engine",
+)
+
+# terminal callee names that count as recording the failure: logging,
+# flight-recorder terminal events, gRPC error surfacing, and the
+# batcher/pool abort plumbing (which sets abort_reason downstream)
+SILENT_EXCEPT_RECORDERS = frozenset({
+    "exception", "error", "warning", "critical",
+    "finish", "finish_shed", "model_event", "snapshot",
+    "abort", "set_details",
+    "_abort_all", "_terminate_outstanding", "_finish", "_rec_close",
+    "shed", "note_failed_restore",
+})
+
+BROAD_EXCEPTION_NAMES = frozenset({"Exception", "BaseException"})
+
+
 # -- knob/docs drift (rule knob-docs) ---------------------------------------
 
 KNOB_RE = re.compile(r"AIOS_TPU_[A-Z0-9_]+")
@@ -209,6 +238,8 @@ class Registry:
     local_locks: Dict[Tuple[str, str, str], str] = field(
         default_factory=lambda: dict(LOCAL_LOCKS))
     dispatch_hygiene_modules: Tuple[str, ...] = DISPATCH_HYGIENE_MODULES
+    silent_except_prefixes: Tuple[str, ...] = SILENT_EXCEPT_PREFIXES
+    silent_except_recorders: frozenset = SILENT_EXCEPT_RECORDERS
 
     def lock_named(self, name: str) -> Optional[LockDecl]:
         for d in self.locks:

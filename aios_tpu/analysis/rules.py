@@ -15,6 +15,10 @@ Rule ids (the ``--rule`` filter and waiver pragmas use these):
     modules must be reachable from an AOT-warmup registration
     (``warmup`` / ``_compile_aot`` / ``compile_*``), keeping the PR 6
     "compile counters flat after warmup" invariant statically;
+  * ``silent-except`` — broad ``except Exception``/``BaseException``/
+    bare handlers in ``serving/`` + ``engine/`` must record the failure
+    (re-raise, log, or land an abort/terminal cause) — fault paths must
+    never be observability black holes;
   * ``knob-docs`` — every ``AIOS_TPU_*`` string in the tree appears in
     ``docs/CONFIG.md`` (and vice versa: stale doc rows are findings);
   * ``metric-catalog`` — ``aios_tpu_*`` instruments are constructed only
@@ -51,6 +55,7 @@ RULE_IDS = (
     "lock-order",
     "guarded-by",
     "jit-warmup",
+    "silent-except",
     "knob-docs",
     "metric-catalog",
     "waiver-reason",
@@ -107,6 +112,8 @@ class Analyzer:
             self._check_guarded_by()
         if "jit-warmup" in want:
             self._check_dispatch_hygiene()
+        if "silent-except" in want:
+            self._check_silent_except()
         if "knob-docs" in want:
             self._check_knob_drift()
         if "metric-catalog" in want:
@@ -629,6 +636,72 @@ class Analyzer:
                     f"AOT-warmup registration (warmup/_compile_aot/"
                     f"compile_*) — it will compile on the serving hot "
                     f"path",
+                ))
+
+    # -- rule: silent-except (fault paths must not be black holes) -----------
+
+    @staticmethod
+    def _is_broad_handler(node: ast.ExceptHandler) -> bool:
+        def broad(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in reg.BROAD_EXCEPTION_NAMES
+            if isinstance(expr, ast.Attribute):
+                return expr.attr in reg.BROAD_EXCEPTION_NAMES
+            return False
+
+        t = node.type
+        if t is None:  # bare `except:`
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(broad(e) for e in t.elts)
+        return broad(t)
+
+    def _handler_records(self, node: ast.ExceptHandler) -> bool:
+        """Whether the handler body records the failure: re-raises, logs
+        it, lands an abort/terminal cause, or forwards to the abort
+        plumbing (registry SILENT_EXCEPT_RECORDERS)."""
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    return True
+                if isinstance(sub, ast.Call):
+                    chain = callee_chain(sub)
+                    if chain and chain[-1] in self.reg.silent_except_recorders:
+                        return True
+                if isinstance(sub, ast.keyword) and sub.arg == "abort_reason":
+                    return True
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        sub.targets if isinstance(sub, ast.Assign)
+                        else [sub.target]
+                    )
+                    if any(
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "abort_reason"
+                        for t in targets
+                    ):
+                        return True
+        return False
+
+    def _check_silent_except(self) -> None:
+        for mi in self.modules:
+            if not mi.name.startswith(
+                tuple(self.reg.silent_except_prefixes)
+            ):
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_broad_handler(node):
+                    continue
+                if self._handler_records(node):
+                    continue
+                self.findings.append(mi.finding(
+                    "silent-except", node.lineno,
+                    "broad except handler swallows the failure without "
+                    "recording it (no raise / log / abort cause) — fault "
+                    "paths must not be observability black holes; record "
+                    "the failure or waive with a reason",
                 ))
 
     # -- rule 5: knob/docs drift + metric catalog ----------------------------

@@ -251,6 +251,10 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         # an explicit 0 forwards (it means "never auto-disable",
         # overriding a ModelConfig.spec_min_accept default)
         ("spec_min_accept", "AIOS_TPU_SPEC_MIN_ACCEPT", True),
+        # failover_retries = 0 forwards (failover OFF, overriding the
+        # serving default of 2)
+        ("failover_retries", "AIOS_TPU_FAILOVER_RETRIES", True),
+        ("failover_backoff_ms", "AIOS_TPU_FAILOVER_BACKOFF_MS", False),
     ):
         raw = m.get(cfg_key, "")
         if raw in ("", None):
@@ -263,4 +267,16 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
             continue
         if value > 0 or (value == 0 and zero_ok):
             put(env_key, str(int(value) if value == int(value) else value))
+    # [faults]: deterministic fault injection (docs/FAULTS.md). The
+    # schedule string IS the AIOS_TPU_FAULTS grammar; a separate `seed`
+    # key prepends for convenience. Deliberately env-beats-config like
+    # everything else — an operator running a live chaos drill via env
+    # wins over a config left armed.
+    f = cfg.section("faults")
+    schedule = str(f.get("schedule", "") or "").strip()
+    if schedule:
+        seed = f.get("seed", "")
+        if str(seed).strip() and "seed=" not in schedule:
+            schedule = f"seed={seed};{schedule}"
+        put("AIOS_TPU_FAULTS", schedule)
     return env

@@ -34,6 +34,7 @@ from .engine import (
     JUMP_BUCKETS, ChunkedPrefill, PendingDecode, TPUEngine, _env_flag,
 )
 from .paged import PoolExhausted
+from .. import faults
 from ..obs import instruments as obs
 from ..obs import flightrec
 
@@ -60,6 +61,10 @@ SPEC_REPROBE_SECS = 10.0
 
 # EWMA smoothing for the per-dispatch draft-acceptance ratio.
 SPEC_EWMA_ALPHA = 0.3
+
+# retry-after hint for a retryable crash abort that reached the client
+# (the pool's failover budget was exhausted, or there was no pool)
+DEFAULT_RETRY_AFTER_MS = 1000
 
 
 @dataclass
@@ -89,6 +94,11 @@ class Request:
     # service (with tenant + trace context), the pool, or the batcher —
     # whoever sees the request first. None when recording is disabled.
     rec: object = field(default=None, repr=False, compare=False)
+    # transparent-failover controller (serving/failover.py), set by the
+    # pool: when this request dies with a retryable abort, the controller
+    # claims the terminal event and resumes the stream on a surviving
+    # replica instead of surfacing a truncation. None = no failover.
+    failover: object = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -156,6 +166,19 @@ class RequestHandle:
     @property
     def abort_reason(self) -> str:
         return self._live.abort_reason
+
+    @property
+    def retry_after_ms(self) -> int:
+        """Backoff hint for a RETRYABLE abort (0 when not aborted, or
+        when retrying cannot help — e.g. the prompt exceeds the pool).
+        The runtime service forwards it as ``retry-after-ms`` trailing
+        metadata, the same convention as admission sheds."""
+        reason = self._live.abort_reason
+        if not reason:
+            return 0
+        if flightrec.abort_cause(reason) in flightrec.RETRYABLE_ABORT_CAUSES:
+            return DEFAULT_RETRY_AFTER_MS
+        return 0
 
     @property
     def ttft_ms(self) -> float:
@@ -908,6 +931,11 @@ class ContinuousBatcher:
         dispatch's tokens (``_gap_wait``) is subtracted — that's device
         time, and counting it would make the pipelined gap read as if
         the host were busier than the sync loop's."""
+        act = faults.point("dispatch.delay", self.engine.cfg.name)
+        if act is not None and act.delay_s > 0:
+            # injected host stall: lands in the host-gap accounting like
+            # any real slow-host phase would (docs/FAULTS.md)
+            time.sleep(act.delay_s)
         gap = None
         if self._gap_mark is not None:
             gap = time.monotonic() - self._gap_mark - self._gap_wait
@@ -962,21 +990,34 @@ class ContinuousBatcher:
     def _rec_close(self, live: _Live) -> None:
         """Finalize the request's timeline into the recorder ring —
         called on EVERY end-of-life path, right before the consumer's
-        end-of-stream lands."""
+        end-of-stream lands. Accounting is CUMULATIVE over the timeline
+        (one client request may span several batcher attempts under
+        transparent failover): tokens accumulate, TTFT anchors to the
+        timeline's origin (failover delay counts against it — the SLO
+        contract), TPOT spreads the post-first-token wall time over
+        every token the client actually received."""
         rec = live.req.rec
         if rec is None:
             return
-        rec.tokens_out = live.produced
-        if live.first_token_at:
-            rec.ttft_ms = (
-                live.first_token_at - live.submitted_at
-            ) * 1000.0
-            if live.produced > 1:
-                rec.tpot_ms = (
-                    (time.monotonic() - live.first_token_at) * 1000.0
-                    / (live.produced - 1)
-                )
+        rec.tokens_out += live.produced
+        if live.first_token_at and not rec.ttft_ms:
+            rec.ttft_ms = (live.first_token_at - rec.t0) * 1000.0
+        if rec.ttft_ms and rec.tokens_out > 1:
+            rec.tpot_ms = (
+                ((time.monotonic() - rec.t0) * 1000.0 - rec.ttft_ms)
+                / (rec.tokens_out - 1)
+            )
         if live.abort_reason:
+            fo = live.req.failover
+            if fo is not None and fo.claims(live.abort_reason):
+                # the failover controller owns this request's terminal
+                # event: it either resumes the stream on a surviving
+                # replica (the SAME timeline keeps accumulating) or
+                # finishes it aborted once the retry budget exhausts —
+                # finishing here would freeze the record mid-recovery
+                # and ding SLO availability for a request the client
+                # may yet see complete
+                return
             flightrec.RECORDER.finish(
                 rec, "aborted", abort_reason=live.abort_reason
             )
@@ -1123,6 +1164,7 @@ class ContinuousBatcher:
             if live.slot >= 0:
                 try:
                     self.engine.release(live.slot)
+                # aios: waive(silent-except): best-effort slot release during teardown — the abort itself is recorded via live.abort_reason on the very next line
                 except Exception:  # noqa: BLE001
                     pass
             self._rec_close(live)
@@ -1279,6 +1321,17 @@ class ContinuousBatcher:
         self._admit()
         with self._lock:
             slots = {s: l for s, l in self._live.items()}
+        if slots:
+            # chaos: a scheduler crash mid-decode — the exception rides
+            # the real _run -> _abort_all -> respawn path, gated on live
+            # slots so idle wake-loop ticks don't consume trigger hits
+            # (an nth:N schedule then counts DECODE ticks, which is what
+            # a deterministic crash drill wants to aim at)
+            act = faults.point("pool.scheduler_crash", self.engine.cfg.name)
+            if act is not None:
+                raise faults.InjectedFault(
+                    f"injected scheduler crash ({act.mode}, hit {act.hit})"
+                )
         if not slots:
             # nothing live NOW: land whatever the last pipelined dispatch
             # produced (its requests retired mid-consume, so this usually

@@ -192,6 +192,7 @@ def cpu_device():
     (Shared with TPUEngine's host-quantize path — keep the probe single.)"""
     try:
         return jax.local_devices(backend="cpu")[0]
+    # aios: waive(silent-except): capability probe — "no CPU backend" IS the answer (None), nothing failed
     except Exception:  # noqa: BLE001
         return None
 

@@ -36,6 +36,7 @@ import numpy as np
 
 from . import model, paged, sampling, spec
 from .config import ModelConfig
+from .. import faults
 from ..analysis.locks import make_lock
 from ..obs import instruments as obs
 from ..obs import flightrec
@@ -201,6 +202,7 @@ def _on_accelerator(params) -> bool:
             try:
                 if leaf.devices().pop().platform != "cpu":
                     return True
+            # aios: waive(silent-except): placement probe over possibly-deleted arrays — an unreadable leaf just doesn't vote
             except Exception:  # noqa: BLE001
                 continue
     return False
@@ -464,6 +466,7 @@ class TPUEngine:
         on_tpu = False
         try:
             on_tpu = jax.default_backend() == "tpu"
+        # aios: waive(silent-except): backend probe at construction — no backend registered means "not TPU", the default already set
         except Exception:
             pass
         if shardings is not None and not self.quant_cache and not self.seq_sharded:
@@ -868,6 +871,9 @@ class TPUEngine:
             )
             obs.PREFIX_HOST_MISSES.labels(model=name).set_function(
                 store_stat("misses")
+            )
+            obs.PREFIX_HOST_MISSES_CORRUPT.labels(model=name).set_function(
+                store_stat("corruptions")
             )
             self._obs_restore_hist = obs.PREFIX_HOST_RESTORE_SECONDS.labels(
                 model=name
@@ -1967,6 +1973,13 @@ class TPUEngine:
             return jnp.asarray(a)
 
         try:
+            act = faults.point("host_store.restore_fail", self.cfg.name)
+            if act is not None:
+                # chaos: the restore dies mid-flight — recovery is the
+                # REAL fallback below (pages returned, normal prefill)
+                raise faults.InjectedFault(
+                    f"injected restore failure (hit {act.hit})"
+                )
             args = [stacked("k"), stacked("v")]
             if self.quant_cache:
                 args += [stacked("k_s"), stacked("v_s")]
@@ -1978,9 +1991,13 @@ class TPUEngine:
             # exactly under the HBM pressure that evicted these pages, so
             # RESOURCE_EXHAUSTED here is plausible): give the allocated
             # pages back — leaking them at refcount 1 would shrink the
-            # pool forever — and fall back to normal prefill
+            # pool forever — and fall back to normal prefill. The probe
+            # counted a hit; the restore never happened, so the store
+            # records a miss too (the ratio predicts recompute cost).
             for p in pages:
                 self.allocator.decref(p)
+            if self.host_store is not None:
+                self.host_store.note_failed_restore()
             log.exception(
                 "host-tier restore failed; recomputing %d page(s)", n
             )
@@ -2511,6 +2528,7 @@ class TPUEngine:
             out["host_tier_restores"] = s.restores
             out["host_tier_hits"] = s.hits
             out["host_tier_misses"] = s.misses
+            out["host_tier_corrupt"] = s.corruptions
             out["host_tier_restore_s"] = round(self.host_restore_seconds, 3)
         return out
 

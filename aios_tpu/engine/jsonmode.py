@@ -308,6 +308,7 @@ def token_bytes_table(tokenizer, vocab_size: int) -> List[Optional[bytes]]:
             for i in range(vocab_size):
                 try:
                     s = tokenizer.decode([i])
+                # aios: waive(silent-except): one-time vocab-table build — an undecodable id simply has no byte mapping (masked out)
                 except Exception:  # noqa: BLE001
                     continue
                 if s:

@@ -22,11 +22,13 @@ slots x context on a TPU chip (SURVEY.md section 7.2, hard part no. 1).
 from __future__ import annotations
 
 import logging
+import zlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import faults
 from ..analysis.locks import make_lock
 
 log = logging.getLogger("aios.paged")
@@ -130,6 +132,12 @@ class PageAllocator:
         return -(-rows // self.page_size)  # ceil
 
     def _take(self, grow: int, replica: int = 0) -> None:
+        act = faults.point("allocator.pressure")
+        if act is not None:
+            # chaos: synthetic pool pressure — rides the real
+            # PoolExhausted recovery (victim eviction at decode grow /
+            # prefill, restore fallback at alloc_pages)
+            raise PoolExhausted(grow, len(self._free[replica]), replica)
         free = self._free[replica]
         if grow > len(free) and self.reclaimer is not None:
             self.reclaimer(grow - len(free))
@@ -294,7 +302,17 @@ class HostPageStore:
     the byte budget is enforced by LRU eviction. The store has its own
     lock: the spill worker writes from its background thread, the engine
     reads under its dispatch lock, and the serving router peeks without
-    either."""
+    either.
+
+    Integrity: every entry carries a crc32 computed at spill time and
+    verified at restore-probe time — host RAM sits outside the device's
+    ECC domain and an entry may be days old, so a flipped byte would
+    otherwise scatter silently into live KV and poison every request
+    sharing the prefix. A mismatch drops the entry (counted by
+    ``corruptions`` / ``aios_tpu_prefix_host_corrupt_total``) and the
+    chain truncates there: the caller recomputes instead of restoring
+    garbage. The ``host_store.corrupt`` fault point (docs/FAULTS.md)
+    flips a byte of a matched entry to drive this path on demand."""
 
     def __init__(self, max_bytes: int) -> None:
         self.max_bytes = int(max_bytes)
@@ -302,16 +320,32 @@ class HostPageStore:
         self._entries: "OrderedDict[bytes, Dict[str, np.ndarray]]" = (
             OrderedDict()
         )
+        #: guarded_by _lock
+        self._crcs: Dict[bytes, int] = {}
         self.bytes_resident = 0  #: guarded_by _lock
         self.spills = 0  # entries accepted from HBM evictions
         self.restores = 0  # entries promoted back into pool pages
         self.hits = 0  # restore probes that found >= 1 entry
         self.misses = 0
+        self.corruptions = 0  # entries dropped on crc32 mismatch
         self._lock = make_lock("host_store")
 
     @staticmethod
     def _entry_bytes(entry: Dict[str, np.ndarray]) -> int:
         return sum(int(a.nbytes) for a in entry.values())
+
+    @staticmethod
+    def _entry_crc(entry: Dict[str, np.ndarray]) -> int:
+        crc = 0
+        for key in sorted(entry):
+            a = entry[key]
+            if not a.flags["C_CONTIGUOUS"]:
+                a = np.ascontiguousarray(a)
+            # checksum the array's buffer directly — tobytes() would
+            # copy every page just to feed the crc, doubling memory
+            # traffic on each spill and restore probe
+            crc = zlib.crc32(a, crc)
+        return crc
 
     def __len__(self) -> int:
         with self._lock:
@@ -319,19 +353,24 @@ class HostPageStore:
 
     def put(self, h: bytes, entry: Dict[str, np.ndarray]) -> None:
         """Insert a spilled page (the newest entry; LRU evicts past the
-        byte budget). An entry bigger than the whole budget is dropped."""
+        byte budget). An entry bigger than the whole budget is dropped.
+        The crc32 is computed OUTSIDE the lock (spill-worker thread CPU
+        time; the engine's restore probe shares this lock)."""
         nb = self._entry_bytes(entry)
         if nb > self.max_bytes:
             return
+        crc = self._entry_crc(entry)
         with self._lock:
             old = self._entries.pop(h, None)
             if old is not None:
                 self.bytes_resident -= self._entry_bytes(old)
             self._entries[h] = entry
+            self._crcs[h] = crc
             self.bytes_resident += nb
             self.spills += 1
             while self.bytes_resident > self.max_bytes and self._entries:
-                _, dropped = self._entries.popitem(last=False)
+                dropped_h, dropped = self._entries.popitem(last=False)
+                self._crcs.pop(dropped_h, None)
                 self.bytes_resident -= self._entry_bytes(dropped)
 
     def match_chain(
@@ -340,20 +379,64 @@ class HostPageStore:
         """Longest stored prefix of ``hashes`` (LRU refreshed, hit/miss
         counted once per probe). Entries stay resident until the caller
         confirms the restore with ``discard`` — a failed restore (pool
-        exhausted mid-allocation) must not lose the spilled KV."""
-        out: List[Tuple[bytes, Dict[str, np.ndarray]]] = []
+        exhausted mid-allocation) must not lose the spilled KV.
+
+        Every matched entry's crc32 is verified before it is handed out;
+        a mismatch drops the entry and truncates the chain there (the
+        caller recomputes the tail — restoring a corrupt page would
+        poison every request sharing the prefix). The crc pass runs
+        OUTSIDE the lock (put()'s rationale, mirrored: the spill worker
+        and concurrent probes must not stall behind checksum CPU time);
+        entries are immutable once stored, and the drop re-checks
+        identity under the lock in case a concurrent put replaced the
+        hash meanwhile."""
+        candidates: List[Tuple[bytes, Dict[str, np.ndarray], int]] = []
         with self._lock:
             for h in hashes:
                 e = self._entries.get(h)
                 if e is None:
                     break
                 self._entries.move_to_end(h)
-                out.append((h, e))
+                candidates.append((h, e, self._crcs.get(h)))
+        if candidates:
+            # chaos (docs/FAULTS.md): fired only when the probe actually
+            # matched — flipping nothing on a miss would count an
+            # injected fault whose recovery path never ran
+            act = faults.point("host_store.corrupt")
+            if act is not None:
+                a = next(iter(candidates[0][1].values()))
+                a.flat[0] = -a.flat[0] if a.flat[0] else 1
+        out: List[Tuple[bytes, Dict[str, np.ndarray]]] = []
+        bad: Optional[Tuple[bytes, Dict[str, np.ndarray]]] = None
+        for h, e, crc in candidates:
+            if crc != self._entry_crc(e):
+                bad = (h, e)
+                break
+            out.append((h, e))
+        with self._lock:
+            if bad is not None and self._entries.get(bad[0]) is bad[1]:
+                self._entries.pop(bad[0], None)
+                self._crcs.pop(bad[0], None)
+                self.bytes_resident -= self._entry_bytes(bad[1])
+                self.corruptions += 1
+                log.error(
+                    "host-tier page failed crc32 verification; "
+                    "dropped (chain truncated at %d of %d)",
+                    len(out), len(hashes),
+                )
             if out:
                 self.hits += 1
             else:
                 self.misses += 1
         return out
+
+    def note_failed_restore(self) -> None:
+        """A probe hit but the restore itself failed (scatter error or an
+        injected ``host_store.restore_fail``): count it as a miss too —
+        the request paid a full recompute, which is what the hit/miss
+        ratio is supposed to predict."""
+        with self._lock:
+            self.misses += 1
 
     def peek_chain(self, hashes: Sequence[bytes]) -> int:
         """Length of the longest stored prefix WITHOUT touching LRU order
@@ -374,6 +457,7 @@ class HostPageStore:
         with self._lock:
             for h in hashes:
                 e = self._entries.pop(h, None)
+                self._crcs.pop(h, None)
                 if e is not None:
                     self.bytes_resident -= self._entry_bytes(e)
                     if restored:
@@ -382,6 +466,7 @@ class HostPageStore:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._crcs.clear()
             self.bytes_resident = 0
 
 

@@ -1,0 +1,33 @@
+"""Deterministic fault injection for the serving plane (docs/FAULTS.md).
+
+Hot paths call ``faults.point("<name>")`` — a no-op unless a seeded
+schedule is armed via ``AIOS_TPU_FAULTS`` / boot ``[faults]`` /
+:func:`activate`. See :mod:`aios_tpu.faults.inject` for the catalog,
+trigger grammar, and determinism contract.
+"""
+
+from .inject import (
+    MODES,
+    POINTS,
+    FaultAction,
+    InjectedFault,
+    activate,
+    active,
+    deactivate,
+    fired,
+    install_from_env,
+    point,
+)
+
+__all__ = [
+    "MODES",
+    "POINTS",
+    "FaultAction",
+    "InjectedFault",
+    "activate",
+    "active",
+    "deactivate",
+    "fired",
+    "install_from_env",
+    "point",
+]

@@ -1,0 +1,315 @@
+"""Deterministic, seeded fault injection for the serving plane.
+
+The reference aiOS survives component failure by design — the spawner
+restarts crashed agents and the intelligence hierarchy degrades tier by
+tier — but recovery code nobody can *provoke* is recovery code nobody
+has tested. This module gives the TPU serving plane named injection
+points compiled into its hot paths:
+
+    pool.scheduler_crash    the batcher scheduler thread raises mid-tick
+    dispatch.delay          the decode loop sleeps before a dispatch
+    host_store.restore_fail the host-tier restore dies mid-scatter
+    host_store.corrupt      a spilled page's bytes flip (crc32 catches it)
+    rpc.unavailable         a server RPC aborts UNAVAILABLE + retry-after
+    allocator.pressure      alloc_pages raises PoolExhausted
+    admission.clock_skew    the deadline gate sees a skewed clock
+
+Each point is a **near-zero-cost no-op** unless a schedule is active:
+the hot-path call is one module-global ``None`` check. A schedule comes
+from ``AIOS_TPU_FAULTS`` (or boot ``[faults]`` -> that env, or
+:func:`activate` in tests/bench)::
+
+    AIOS_TPU_FAULTS="seed=42;pool.scheduler_crash=nth:3;\
+dispatch.delay=prob:0.25,delay_ms=20;admission.clock_skew=after:5,skew_ms=2000"
+
+Triggers (the fire decision is a pure function of ``(seed, point,
+hit-index)`` for ``nth``/``prob`` — the same seed and call pattern
+reproduce the same injected-fault sequence, which is what makes a chaos
+run a *regression test* instead of a dice roll):
+
+  * ``nth:N``  — fire exactly on the Nth hit of the point (one-shot);
+  * ``prob:P`` — fire each hit with probability P, drawn from a
+    per-point PRNG seeded with ``(seed, point)`` — one draw per hit;
+  * ``after:T`` — fire on every hit once T seconds have elapsed since
+    activation (wall-clock; for live chaos drills, not determinism).
+
+Optional ``key=value`` params ride after the trigger: ``delay_ms``
+(dispatch.delay), ``skew_ms`` (admission.clock_skew), ``retry_after_ms``
+(rpc.unavailable).
+
+Every fired fault is counted by ``aios_tpu_faults_injected_total{point,
+mode}``, recorded on the flight recorder's model lane as a ``fault``
+event, and appended to a bounded in-process journal (:func:`fired`) so
+a chaos harness can assert the injected sequence was identical across
+re-runs. See docs/FAULTS.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..analysis.locks import make_lock
+from ..obs import instruments as obs
+
+log = logging.getLogger("aios.faults")
+
+__all__ = [
+    "POINTS", "MODES", "FaultAction", "InjectedFault", "activate",
+    "deactivate", "active", "point", "fired", "install_from_env",
+]
+
+# The closed catalog of injection points. A schedule naming anything
+# else logs and skips it (the lenient-env pattern) — a typo must not
+# silently arm nothing while the operator believes chaos is running.
+POINTS = (
+    "pool.scheduler_crash",
+    "dispatch.delay",
+    "host_store.restore_fail",
+    "host_store.corrupt",
+    "rpc.unavailable",
+    "allocator.pressure",
+    "admission.clock_skew",
+)
+
+MODES = ("nth", "prob", "after")
+
+# journal bound: a chaos storm fires tens of faults, not thousands; the
+# cap only guards against a runaway prob:1.0 schedule on a hot point
+_MAX_JOURNAL = 4096
+
+# parameter defaults per point: a schedule that names the point but not
+# its magnitude still injects SOMETHING — a fired fault that is secretly
+# a no-op would count in the metric/journal while exercising nothing
+_PARAM_DEFAULTS: Dict[str, Dict[str, float]] = {
+    "dispatch.delay": {"delay_ms": 10.0},
+    "admission.clock_skew": {"skew_ms": 1000.0},
+    "rpc.unavailable": {"retry_after_ms": 1000.0},
+}
+
+
+class InjectedFault(RuntimeError):
+    """The exception a crash-class injection point raises. Distinct type
+    so recovery-path tests can assert the abort they observe is the one
+    they injected, not an unrelated failure."""
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    """What a fired point tells its call site to do. ``hit`` is the
+    1-based hit index at fire time (the journal's determinism anchor)."""
+
+    point: str
+    mode: str
+    hit: int
+    delay_s: float = 0.0
+    skew_s: float = 0.0
+    retry_after_ms: int = 1000
+
+
+@dataclass
+class _PointSpec:
+    mode: str
+    arg: float  # N for nth, P for prob, T seconds for after
+    params: Dict[str, float] = field(default_factory=dict)
+
+
+class FaultPlan:
+    """One activated schedule: per-point triggers, seeded PRNGs, hit
+    counters, and the fired-fault journal."""
+
+    def __init__(self, schedule: Dict[str, _PointSpec], seed: int) -> None:
+        self.seed = seed
+        self.schedule = schedule
+        self.activated_at = time.monotonic()
+        self._lock = make_lock("faults")
+        #: guarded_by _lock
+        self._hits: Dict[str, int] = {}
+        #: guarded_by _lock
+        self._journal: deque = deque(maxlen=_MAX_JOURNAL)
+        # per-point PRNG seeded by (seed, point): the k-th draw decides
+        # the k-th hit no matter how points interleave across threads
+        self._rngs: Dict[str, random.Random] = {
+            name: random.Random(f"{seed}:{name}") for name in schedule
+        }
+
+    def check(self, name: str, model: str = "") -> Optional[FaultAction]:
+        spec = self.schedule.get(name)
+        if spec is None:
+            return None
+        with self._lock:
+            hit = self._hits.get(name, 0) + 1
+            self._hits[name] = hit
+            if spec.mode == "nth":
+                fire = hit == int(spec.arg)
+            elif spec.mode == "prob":
+                fire = self._rngs[name].random() < spec.arg
+            else:  # after
+                fire = (
+                    time.monotonic() - self.activated_at >= spec.arg
+                )
+            if not fire:
+                return None
+            act = FaultAction(
+                point=name, mode=spec.mode, hit=hit,
+                delay_s=spec.params.get("delay_ms", 0.0) / 1e3,
+                skew_s=spec.params.get("skew_ms", 0.0) / 1e3,
+                retry_after_ms=int(spec.params.get("retry_after_ms", 1000)),
+            )
+            self._journal.append(
+                {"point": name, "mode": spec.mode, "hit": hit,
+                 "model": model}
+            )
+        self._record(act, model)
+        return act
+
+    def _record(self, act: FaultAction, model: str) -> None:
+        """Observability for a fired fault — outside the plan lock (the
+        recorder and metric children take their own)."""
+        obs.FAULTS_INJECTED.labels(point=act.point, mode=act.mode).inc()
+        from ..obs import flightrec  # late: obs.__init__ import order
+
+        flightrec.RECORDER.model_event(
+            model or "faults", "fault",
+            point=act.point, mode=act.mode, hit=act.hit,
+        )
+        log.warning(
+            "fault injected: %s (%s, hit %d)%s",
+            act.point, act.mode, act.hit,
+            f" on {model}" if model else "",
+        )
+
+    def journal(self) -> List[dict]:
+        with self._lock:
+            return list(self._journal)
+
+
+# The active plan. None = faults disabled; the hot-path cost of a
+# disabled point() is one global load + is-None check.
+_PLAN: Optional[FaultPlan] = None
+_swap = threading.Lock()  # activate/deactivate only — never on hot paths
+
+
+def point(name: str, model: str = "") -> Optional[FaultAction]:
+    """The hot-path call: None when no schedule is active or the point
+    does not fire; a :class:`FaultAction` telling the call site what to
+    inject otherwise."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.check(name, model)
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def fired() -> List[dict]:
+    """The active plan's fired-fault journal (empty when inactive) —
+    ordered ``{point, mode, hit, model}`` dicts, the determinism
+    fingerprint chaos re-runs compare."""
+    plan = _PLAN
+    return plan.journal() if plan is not None else []
+
+
+def activate(spec: str, seed: Optional[int] = None) -> FaultPlan:
+    """Arm a schedule programmatically (tests, ``bench.py --chaos``).
+    ``spec`` uses the ``AIOS_TPU_FAULTS`` grammar; an explicit ``seed``
+    overrides the spec's ``seed=`` entry. Returns the plan (its
+    ``journal()`` is the run's injected-fault sequence)."""
+    global _PLAN
+    schedule, spec_seed = _parse(spec)
+    plan = FaultPlan(schedule, seed if seed is not None else spec_seed)
+    with _swap:
+        _PLAN = plan
+    if schedule:
+        log.warning(
+            "fault injection ACTIVE (seed %d): %s", plan.seed,
+            ", ".join(
+                f"{n}={s.mode}:{s.arg:g}" for n, s in schedule.items()
+            ),
+        )
+    return plan
+
+
+def deactivate() -> None:
+    global _PLAN
+    with _swap:
+        _PLAN = None
+
+
+def install_from_env() -> None:
+    """Arm (or disarm) from ``AIOS_TPU_FAULTS`` — called at import so a
+    booted process carries its schedule from birth, and callable again
+    after an env change (tests)."""
+    raw = os.environ.get("AIOS_TPU_FAULTS", "").strip()
+    if raw:
+        activate(raw)
+    else:
+        deactivate()
+
+
+def _parse(spec: str) -> Tuple[Dict[str, _PointSpec], int]:
+    """``seed=42;point=mode:arg[,k=v...];...`` -> (schedule, seed).
+    Malformed entries log and drop (never take down a boot)."""
+    schedule: Dict[str, _PointSpec] = {}
+    seed = 0
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, rest = entry.partition("=")
+        name, rest = name.strip(), rest.strip()
+        if name == "seed":
+            try:
+                seed = int(rest)
+            except ValueError:
+                log.warning("AIOS_TPU_FAULTS: bad seed %r ignored", rest)
+            continue
+        if name not in POINTS:
+            log.warning(
+                "AIOS_TPU_FAULTS: unknown point %r ignored (known: %s)",
+                name, ", ".join(POINTS),
+            )
+            continue
+        head, *params = rest.split(",")
+        mode, _, arg = head.partition(":")
+        mode = mode.strip()
+        if mode not in MODES:
+            log.warning(
+                "AIOS_TPU_FAULTS: %s: unknown trigger %r ignored "
+                "(known: %s)", name, mode, ", ".join(MODES),
+            )
+            continue
+        try:
+            argv = float(arg)
+        except ValueError:
+            log.warning(
+                "AIOS_TPU_FAULTS: %s: bad trigger arg %r ignored",
+                name, arg,
+            )
+            continue
+        kv: Dict[str, float] = dict(_PARAM_DEFAULTS.get(name, ()))
+        ok = True
+        for p in params:
+            k, _, v = p.partition("=")
+            try:
+                kv[k.strip()] = float(v)
+            except ValueError:
+                log.warning(
+                    "AIOS_TPU_FAULTS: %s: bad param %r ignored — "
+                    "dropping the whole entry", name, p,
+                )
+                ok = False
+        if ok:
+            schedule[name] = _PointSpec(mode, argv, kv)
+    return schedule, seed
+
+
+install_from_env()

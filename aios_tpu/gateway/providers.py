@@ -159,7 +159,16 @@ def qwen3_client() -> OpenAICompatClient:
 
 
 class LocalRuntimeClient:
-    """The TPU runtime as a gateway provider (final fallback, always on)."""
+    """The TPU runtime as a gateway provider (final fallback, always on).
+
+    Honors the runtime's backoff convention: a shed or crash-abort comes
+    back as RESOURCE_EXHAUSTED / UNAVAILABLE with ``retry-after-ms``
+    trailing metadata, and this client sleeps the hinted backoff (with
+    jitter — a fleet of gateways must not resubmit in lockstep) and
+    retries up to ``AIOS_TPU_RUNTIME_RETRY_ATTEMPTS`` times (default 2).
+    Errors WITHOUT the hint (wrong model name, invalid schema, a genuine
+    outage) propagate immediately — only the runtime's explicit
+    "try again later" is worth waiting on."""
 
     name = "local"
     supports_json_schema = True  # grammar-guided decoding in the engine
@@ -169,6 +178,7 @@ class LocalRuntimeClient:
 
         self.address = address or service_address("runtime")
         self._stub = None
+        self._channel = None
 
     def available(self) -> bool:
         return True  # router.rs treats local as always-available
@@ -178,8 +188,57 @@ class LocalRuntimeClient:
             from .. import rpc
             from ..services import AIRuntimeStub
 
-            self._stub = AIRuntimeStub(rpc.insecure_channel(self.address))
+            # ONE persistent channel, reused across stub rebuilds and
+            # retries: gRPC channels reconnect on their own after an
+            # UNAVAILABLE, so rebuilding the channel per failure would
+            # either leak sockets (dereference) or — worse — close() a
+            # channel the gateway's OTHER worker threads have healthy
+            # in-flight RPCs on (close cancels every call in progress)
+            if self._channel is None:
+                self._channel = rpc.insecure_channel(self.address)
+            self._stub = AIRuntimeStub(self._channel)
         return self._stub
+
+    @staticmethod
+    def _retry_attempts() -> int:
+        import os
+
+        raw = os.environ.get("AIOS_TPU_RUNTIME_RETRY_ATTEMPTS", "").strip()
+        try:
+            return max(int(raw), 0) if raw else 2
+        except ValueError:
+            return 2
+
+    @staticmethod
+    def _retry_after_ms(exc) -> Optional[int]:
+        """The runtime's retry-after-ms trailing metadata, or None when
+        the error carries no backoff hint (not retryable)."""
+        import grpc
+
+        if exc.code() not in (
+            grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.RESOURCE_EXHAUSTED
+        ):
+            return None
+        try:
+            md = exc.trailing_metadata() or ()
+        except Exception:  # noqa: BLE001 - metadata is advisory
+            return None
+        for k, v in md:
+            if k == "retry-after-ms":
+                try:
+                    return max(int(v), 1)
+                except (TypeError, ValueError):
+                    return None
+        return None
+
+    @staticmethod
+    def _backoff(hint_ms: int) -> None:
+        import random as _random
+        import time as _time
+
+        # jittered: 0.5x..1.5x the hint, capped — the hint is already
+        # the runtime's own drain estimate
+        _time.sleep(min(hint_ms, 30_000) / 1e3 * (0.5 + _random.random()))
 
     def infer(self, prompt: str, system: str, max_tokens: int,
               temperature: float, json_schema: str = "") -> InferResult:
@@ -187,22 +246,28 @@ class LocalRuntimeClient:
 
         from ..proto_gen import runtime_pb2
 
-        try:
-            resp = self._get_stub().Infer(
-                runtime_pb2.InferRequest(
-                    prompt=prompt,
-                    system_prompt=system,
-                    max_tokens=max_tokens or 512,
-                    temperature=temperature,
-                    # structured output rides through to the TPU engine's
-                    # grammar-guided decoding; cloud providers ignore it
-                    json_schema=json_schema,
-                ),
-                timeout=120,
-            )
-        except grpc.RpcError as exc:
-            self._stub = None
-            raise ProviderError(f"local runtime: {exc.details()}") from exc
+        attempts = self._retry_attempts()
+        request = runtime_pb2.InferRequest(
+            prompt=prompt,
+            system_prompt=system,
+            max_tokens=max_tokens or 512,
+            temperature=temperature,
+            # structured output rides through to the TPU engine's
+            # grammar-guided decoding; cloud providers ignore it
+            json_schema=json_schema,
+        )
+        for attempt in range(attempts + 1):
+            try:
+                resp = self._get_stub().Infer(request, timeout=120)
+                break
+            except grpc.RpcError as exc:
+                self._stub = None
+                hint = self._retry_after_ms(exc)
+                if hint is None or attempt >= attempts:
+                    raise ProviderError(
+                        f"local runtime: {exc.details()}"
+                    ) from exc
+                self._backoff(hint)
         return InferResult(
             text=resp.text,
             input_tokens=max(0, resp.tokens_used),
@@ -226,36 +291,54 @@ class LocalRuntimeClient:
 
         from ..proto_gen import runtime_pb2
 
+        request = runtime_pb2.InferRequest(
+            prompt=prompt,
+            system_prompt=system,
+            max_tokens=max_tokens or 512,
+            temperature=temperature,
+            json_schema=json_schema,
+        )
+        attempts = self._retry_attempts()
         stream = None
+        emitted = False
         try:
-            stream = self._get_stub().StreamInfer(
-                runtime_pb2.InferRequest(
-                    prompt=prompt,
-                    system_prompt=system,
-                    max_tokens=max_tokens or 512,
-                    temperature=temperature,
-                    json_schema=json_schema,
-                ),
-                timeout=300,
-            )
-            if register_call is not None:
-                # hand the call to the servicer so its RPC-termination
-                # callback can cancel it cross-thread while this generator
-                # is parked in next() (cancel is thread-safe on gRPC calls)
-                register_call(stream)
-            for chunk in stream:
-                if chunk.text:
-                    yield chunk.text
-                if chunk.done:
+            for attempt in range(attempts + 1):
+                try:
+                    stream = self._get_stub().StreamInfer(
+                        request, timeout=300
+                    )
+                    if register_call is not None:
+                        # hand the call to the servicer so its
+                        # RPC-termination callback can cancel it
+                        # cross-thread while this generator is parked in
+                        # next() (cancel is thread-safe on gRPC calls)
+                        register_call(stream)
+                    for chunk in stream:
+                        if chunk.text:
+                            emitted = True
+                            yield chunk.text
+                        if chunk.done:
+                            return
                     return
-        except grpc.RpcError as exc:
-            # CANCELLED can be our own disconnect-cancel (register_call
-            # path) OR a genuine runtime failure (server restart kills
-            # in-flight RPCs with CANCELLED) — the router tells them apart
-            # via its client_alive probe, not here
-            if exc.code() != grpc.StatusCode.CANCELLED:
-                self._stub = None
-            raise ProviderError(f"local runtime: {exc.details()}") from exc
+                except grpc.RpcError as exc:
+                    # CANCELLED can be our own disconnect-cancel
+                    # (register_call path) OR a genuine runtime failure
+                    # (server restart kills in-flight RPCs with
+                    # CANCELLED) — the router tells them apart via its
+                    # client_alive probe, not here
+                    if exc.code() != grpc.StatusCode.CANCELLED:
+                        self._stub = None
+                    hint = self._retry_after_ms(exc)
+                    if emitted or hint is None or attempt >= attempts:
+                        # once a delta reached the consumer a blind
+                        # resubmit would replay text — the runtime's own
+                        # in-pool failover already covers mid-stream
+                        # crashes transparently; only a shed/crash BEFORE
+                        # the first delta retries here
+                        raise ProviderError(
+                            f"local runtime: {exc.details()}"
+                        ) from exc
+                    self._backoff(hint)
         finally:
             # our consumer can vanish mid-stream (the gateway's client
             # disconnected -> GeneratorExit lands here): cancel the

@@ -59,11 +59,14 @@ log = logging.getLogger("aios.obs")
 # plain/masked decode dispatch, "jump" a grammar jump-ahead run, "spec" a
 # speculative round batch, "restore"/"spill" the host KV tier moving
 # pages, "retire"/"abort"/"cancel" the terminal event, "span" a folded-in
-# finished tracing span, "respawn" a replica crash-respawn (model lane).
+# finished tracing span, "respawn" a replica crash-respawn (model lane),
+# "failover" an in-flight re-route to a surviving replica after a crash
+# (serving/failover.py), "fault" an injected fault firing (model lane,
+# aios_tpu/faults/).
 EVENT_KINDS = (
     "admit", "shed", "route", "queue", "prefill", "decode", "jump",
     "spec", "restore", "spill", "retire", "abort", "cancel", "span",
-    "respawn",
+    "respawn", "failover", "fault",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these
@@ -77,6 +80,18 @@ ABORT_CAUSES = (
     "evicted", "prompt_too_large", "scheduler_failed", "model_unloading",
     "other",
 )
+
+# Abort causes a CLIENT retry (or the pool's transparent failover) can
+# plausibly fix: the replica state that killed the request is transient.
+# The runtime service returns UNAVAILABLE + retry-after-ms trailing
+# metadata for these — the same convention as admission sheds — and
+# serving/failover.py retries them in-flight before the client ever
+# sees the abort ("evicted" only re-routes on a multi-replica pool; the
+# same starved replica would just evict another victim). Deliberate
+# aborts (model_unloading is an operator action, prompt_too_large a
+# client error) stay non-retryable: a backoff hint there would put
+# compliant clients in a futile retry loop.
+RETRYABLE_ABORT_CAUSES = ("scheduler_failed", "evicted")
 
 # Terminal timeline states.
 STATES = ("live", "retired", "cancelled", "aborted", "shed")

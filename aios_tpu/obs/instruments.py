@@ -227,6 +227,12 @@ PREFIX_HOST_MISSES = Gauge(
     "Host-tier chain probes that found nothing (monotonic)",
     ("model",),
 )
+PREFIX_HOST_MISSES_CORRUPT = Gauge(
+    "aios_tpu_prefix_host_corrupt_total",
+    "Spilled pages whose crc32 failed verification at restore probe "
+    "time — dropped and recomputed instead of restored (monotonic)",
+    ("model",),
+)
 PREFIX_HOST_RESTORE_SECONDS = Histogram(
     "aios_tpu_prefix_host_restore_seconds",
     "Host-side wall time to stage + dispatch one host->device prefix "
@@ -319,6 +325,22 @@ SERVING_REPLICA_RESTARTS = Counter(
     "Replica batchers respawned after a scheduler crash "
     "(the spawner-style restart counter, serving-side)",
     ("model",),
+)
+SERVING_FAILOVERS = Counter(
+    "aios_tpu_serving_failover_total",
+    "In-flight requests re-routed after a replica failure, by outcome "
+    "(resumed = resubmitted to a surviving replica; exhausted = retry "
+    "budget spent, surfaced as UNAVAILABLE + retry-after)",
+    ("model", "outcome"),
+)
+
+# -- fault injection (aios_tpu/faults/, docs/FAULTS.md) --------------------
+
+FAULTS_INJECTED = Counter(
+    "aios_tpu_faults_injected_total",
+    "Faults fired by the seeded injection layer (point = injection-point "
+    "name from faults.POINTS, mode = nth|prob|after)",
+    ("point", "mode"),
 )
 
 # -- orchestrator ----------------------------------------------------------

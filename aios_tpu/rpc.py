@@ -115,6 +115,48 @@ def add_to_server(spec: ServiceSpec, servicer: Any, server: grpc.Server) -> None
     )
 
 
+class _FaultUnavailableInterceptor(grpc.ServerInterceptor):
+    """Chaos hook (docs/FAULTS.md): when the ``rpc.unavailable`` fault
+    point fires, the RPC aborts UNAVAILABLE with ``retry-after-ms``
+    trailing metadata instead of reaching the servicer — the exact shape
+    a client sees when a whole serving process is mid-restart, for
+    driving client retry/backoff paths on demand. A no-op (one global
+    load in ``faults.point``) unless a fault schedule is armed."""
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        from . import faults
+
+        act = faults.point("rpc.unavailable")
+        if act is None:
+            return handler
+
+        def abort(request, context):
+            context.set_trailing_metadata(
+                (("retry-after-ms", str(act.retry_after_ms)),)
+            )
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"injected rpc.unavailable (hit {act.hit})",
+            )
+
+        if handler.unary_unary is not None:
+            return grpc.unary_unary_rpc_method_handler(
+                abort,
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.unary_stream is not None:
+            return grpc.unary_stream_rpc_method_handler(
+                abort,
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler  # stream-request cardinalities: not injected
+
+
 def create_server(
     max_workers: int = 16, options: Tuple[Tuple[str, Any], ...] | None = None
 ) -> grpc.Server:
@@ -127,11 +169,14 @@ def create_server(
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
         )
     )
-    interceptors: Tuple[Any, ...] = ()
+    # the fault interceptor goes INNERMOST (last): an injected
+    # UNAVAILABLE must still flow through the obs interceptors' metrics
+    # and spans — the operator drilling chaos is watching exactly those
+    interceptors: Tuple[Any, ...] = (_FaultUnavailableInterceptor(),)
     if _obs_enabled():
         from .obs.interceptors import server_interceptors
 
-        interceptors = server_interceptors()
+        interceptors = tuple(server_interceptors()) + interceptors
     return grpc.server(
         concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
         options=opts,

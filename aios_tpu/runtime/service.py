@@ -166,7 +166,16 @@ class RuntimeService(AIRuntimeServicer):
         if handle.aborted:
             # mid-request abort (model unload, scheduler failure): the
             # collected tokens are a truncation — error out, don't present
-            # them as a completion
+            # them as a completion. RETRYABLE causes (a crashed replica
+            # whose failover budget was exhausted) additionally carry a
+            # retry-after-ms hint, the admission-shed convention, so
+            # compliant clients back off and resubmit instead of treating
+            # the crash as permanent.
+            retry_ms = getattr(handle, "retry_after_ms", 0)
+            if retry_ms:
+                context.set_trailing_metadata(
+                    (("retry-after-ms", str(retry_ms)),)
+                )
             context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 f"request aborted: {handle.abort_reason}",
@@ -214,9 +223,20 @@ class RuntimeService(AIRuntimeServicer):
                 model=m.name, rpc="StreamInfer"
             ).observe(time.time() - t0)
             if handle.aborted:
-                # ABORTED status instead of a done-chunk: the client must
-                # not mistake a mid-stream unload for a short completion
-                context.set_code(grpc.StatusCode.ABORTED)
+                # an error status instead of a done-chunk: the client
+                # must not mistake a mid-stream abort for a short
+                # completion. RETRYABLE causes (crashed replica, failover
+                # budget spent) surface UNAVAILABLE + retry-after-ms so
+                # the client resubmits — the re-prefill is a prefix-cache
+                # hit; deliberate aborts (unload) stay ABORTED.
+                retry_ms = getattr(handle, "retry_after_ms", 0)
+                if retry_ms:
+                    context.set_trailing_metadata(
+                        (("retry-after-ms", str(retry_ms)),)
+                    )
+                    context.set_code(grpc.StatusCode.UNAVAILABLE)
+                else:
+                    context.set_code(grpc.StatusCode.ABORTED)
                 context.set_details(
                     f"stream aborted: {handle.abort_reason}"
                 )

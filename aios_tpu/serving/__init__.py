@@ -10,12 +10,14 @@ sheds work a saturated pool cannot serve inside its deadline
 
 from .admission import AdmissionController, AdmissionError, TokenBucket, tenant_of
 from .config import ServingConfig
+from .failover import FailoverHandle
 from .pool import Replica, ReplicaPool
 from .router import Router
 
 __all__ = [
     "AdmissionController",
     "AdmissionError",
+    "FailoverHandle",
     "Replica",
     "ReplicaPool",
     "Router",

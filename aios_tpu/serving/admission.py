@@ -29,6 +29,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from .. import faults
 from ..analysis.locks import make_lock
 from ..obs import instruments as obs
 from ..obs.flightrec import SHED_CAUSES
@@ -200,6 +201,12 @@ class AdmissionController:
                        rate_tps: float) -> None:
         if deadline_s is None:
             return
+        act = faults.point("admission.clock_skew", self.model)
+        if act is not None and act.skew_s:
+            # chaos: the gate's clock runs fast — deadlines look closer
+            # than they are, driving deadline sheds (and their
+            # retry-after metadata) on demand
+            deadline_s = deadline_s - act.skew_s
         rate = rate_tps or self.cfg.assumed_tokens_per_sec
         if rate <= 0:
             return  # no observed rate yet: cannot estimate, never shed

@@ -62,6 +62,15 @@ class ServingConfig:
     # observed rate is 0 (cold pool), assumed_tokens_per_sec substitutes;
     # 0 disables the feasibility check until a rate is observed.
     assumed_tokens_per_sec: float = 0.0
+    # transparent failover (serving/failover.py): how many times an
+    # in-flight request whose replica died (or was evicted, on a
+    # multi-replica pool) is re-routed to a surviving replica before the
+    # abort surfaces as UNAVAILABLE + retry-after. 0 disables wrapping
+    # (the pre-failover truncate-and-error behavior).
+    failover_retries: int = 2
+    # base of the failover exponential backoff (doubles per attempt,
+    # +-50% jitter, capped at failover.MAX_BACKOFF_S)
+    failover_backoff_ms: float = 50.0
 
     @classmethod
     def from_env(cls, replicas_default: int = 1) -> "ServingConfig":
@@ -87,4 +96,8 @@ class ServingConfig:
                 "AIOS_TPU_ROUTE_OVERLAP_MIN", 0.25
             ),
             assumed_tokens_per_sec=_env_float("AIOS_TPU_ASSUMED_TPS", 0.0),
+            failover_retries=_env_int("AIOS_TPU_FAILOVER_RETRIES", 2),
+            failover_backoff_ms=_env_float(
+                "AIOS_TPU_FAILOVER_BACKOFF_MS", 50.0
+            ),
         )

@@ -33,6 +33,7 @@ from ..obs import flightrec
 from ..obs.flightrec import SHED_CAUSES
 from .admission import AdmissionController, AdmissionError
 from .config import ServingConfig
+from .failover import FailoverHandle
 from .router import Router
 
 log = logging.getLogger("aios.serving")
@@ -109,6 +110,7 @@ class ReplicaPool:
             for r in self.replicas:
                 try:
                     r.batcher.shutdown()
+                # aios: waive(silent-except): best-effort cleanup of a failed pool spawn — the root cause re-raises right below
                 except Exception:  # noqa: BLE001
                     pass
             raise
@@ -181,7 +183,12 @@ class ReplicaPool:
                deadline_s: Optional[float] = None):
         """Admission -> routing -> replica submit. Raises
         :class:`AdmissionError` when the request is shed (the service
-        maps it to RESOURCE_EXHAUSTED + retry-after-ms metadata)."""
+        maps it to RESOURCE_EXHAUSTED + retry-after-ms metadata).
+        Eligible requests come back wrapped in a
+        :class:`~aios_tpu.serving.failover.FailoverHandle`: a replica
+        crash mid-stream resumes on a surviving replica instead of
+        truncating (grammar-constrained requests are not wrapped — a
+        mid-stream resume cannot reproduce their forced first token)."""
         # flight recorder: the runtime service opens the timeline with
         # tenant + trace context; direct pool callers (tests, bench) get
         # one here so every request through the front door is recorded
@@ -191,8 +198,23 @@ class ReplicaPool:
                 prompt_tokens=len(req.prompt_ids),
                 priority=getattr(req, "priority", 0),
             )
+        fo = None
+        if (
+            self.cfg.failover_retries > 0
+            and getattr(req, "json_schema", None) is None
+            and not getattr(req, "json_mode", False)
+            and getattr(req, "failover", None) is None
+        ):
+            # installed BEFORE the batcher sees the request: a crash in
+            # the window between submit and wrap would otherwise finish
+            # the timeline as aborted and strand the retry
+            fo = FailoverHandle(
+                self, req, tenant, self.cfg.failover_retries,
+                self.cfg.failover_backoff_ms,
+            )
+            req.failover = fo
         try:
-            return self._submit(req, tenant, deadline_s)
+            handle = self._submit(req, tenant, deadline_s)
         except AdmissionError as e:
             with self._lock:
                 self._shed[e.cause] = self._shed.get(e.cause, 0) + 1
@@ -203,6 +225,75 @@ class ReplicaPool:
                 req.rec, e.cause, e.retry_after_ms, model=self.name
             )
             raise
+        if fo is None:
+            return handle
+        fo._inner = handle
+        return fo
+
+    def submit_failover(self, req, cause: str, attempt: int,
+                        backoff_ms: float):
+        """Re-route an in-flight request whose replica failed
+        (serving/failover.py). Admission is SKIPPED: the quota was
+        debited and the queue/deadline gates judged this request at
+        first admission — a crashed replica must not double-bill the
+        tenant or shed a stream the client is already consuming.
+        Crashed replicas respawn first; then the grown prompt (prompt +
+        already-emitted tokens) routes normally — the radix index / host
+        tier make the re-prefill a cache hit. An ``evicted`` failover
+        routes least-loaded instead (sticky/prefix would send it
+        straight back to the starved replica that just evicted it)."""
+        if self._draining or self._closed:
+            raise RuntimeError(f"model {self.name} is draining")
+        self._respawn_dead()
+        route_ids, _ = self._route_ids(req)
+        route_detail: Dict[str, int] = {}
+        if cause == "evicted" and len(self.replicas) > 1:
+            idx, reason = self.router.least_loaded(self.replicas), \
+                "least_loaded"
+        else:
+            hashes = self.replicas[0].prefix_hashes(route_ids)
+            idx, reason = self.router.select(
+                self.replicas, route_ids, req.request_id, hashes=hashes,
+                detail=route_detail,
+            )
+        rec = getattr(req, "rec", None)
+        if rec is not None:
+            rec.replica, rec.route_reason = idx, reason
+            rec.event(
+                "failover", attempt=attempt, cause=cause,
+                backoff_ms=backoff_ms, replica=idx, reason=reason,
+                resumed_tokens=len(req.prompt_ids), **route_detail,
+            )
+        task_id = req.request_id
+        handle = self.replicas[idx].batcher.submit(req)
+        self._count_route(reason, task_id, idx)
+        return handle
+
+    def _route_ids(self, req):
+        """The ADMISSION-TRUNCATED prompt (engines keep only the last
+        max_context-1 ids) + the cap — shared by first-admission routing
+        and failover re-routing: the router's overlap threshold is a
+        fraction of the prompt it compares against cacheable rows, so an
+        over-length raw prompt would make the prefix route
+        unreachable."""
+        cap = getattr(self.replicas[0].engine, "max_context", None)
+        route_ids = req.prompt_ids
+        if cap is not None and len(route_ids) > cap - 1:
+            route_ids = route_ids[-(cap - 1):]
+        return route_ids, cap
+
+    def _count_route(self, reason: str, task_id: str, idx: int) -> None:
+        """Routing bookkeeping shared by _submit and submit_failover:
+        tallies + metric, and the sticky binding — except for ``spill``
+        (a one-off overflow must not REBIND the task away from its
+        cache-holding replica: sticky outranks prefix at select time, so
+        recording the spill index would pin every later continuation to
+        the wrong replica after the full one drains)."""
+        with self._lock:
+            self._routed[reason] = self._routed.get(reason, 0) + 1
+        self._obs_routed[reason].inc()
+        if reason != "spill":
+            self.router.note_routed(task_id, idx)
 
     def _submit(self, req, tenant: str, deadline_s: Optional[float]):
         if self._draining or self._closed:
@@ -210,16 +301,9 @@ class ReplicaPool:
                 "draining", f"model {self.name} is draining", 2000
             )
         self._respawn_dead()
-        # route on the ADMISSION-TRUNCATED prompt (engines keep only the
-        # last max_context-1 ids): the router's overlap threshold is a
-        # fraction of the prompt it compares against cacheable rows, so
-        # an over-length raw prompt would make the prefix route
-        # unreachable. Hash the blocks ONCE; every replica's probe reuses
-        # the digests (replicas share page size and truncation).
-        cap = getattr(self.replicas[0].engine, "max_context", None)
-        route_ids = req.prompt_ids
-        if cap is not None and len(route_ids) > cap - 1:
-            route_ids = route_ids[-(cap - 1):]
+        # hash the blocks ONCE; every replica's probe reuses the digests
+        # (replicas share page size and truncation — see _route_ids)
+        route_ids, cap = self._route_ids(req)
         hashes = self.replicas[0].prefix_hashes(route_ids)
         rec = getattr(req, "rec", None)
         route_detail: Dict[str, int] = {}
@@ -287,15 +371,7 @@ class ReplicaPool:
         # per-batcher counters and collide across replicas)
         task_id = req.request_id
         handle = r.batcher.submit(req)
-        with self._lock:
-            self._routed[reason] = self._routed.get(reason, 0) + 1
-        self._obs_routed[reason].inc()
-        if reason != "spill":
-            # a one-off overflow must not REBIND the task away from its
-            # cache-holding replica: sticky outranks prefix at select
-            # time, so recording the spill index would pin every later
-            # continuation to the wrong replica after the full one drains
-            self.router.note_routed(task_id, idx)
+        self._count_route(reason, task_id, idx)
         return handle
 
     def _respawn_dead(self) -> None:
@@ -310,6 +386,7 @@ class ReplicaPool:
                 )
                 try:
                     r.batcher.shutdown()
+                # aios: waive(silent-except): the crashed batcher's thread may already be gone — the crash itself is logged + counted just above/below
                 except Exception:  # noqa: BLE001 - old thread may be gone
                     pass
                 r.batcher = self._spawn_batcher(r.engine)

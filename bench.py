@@ -773,6 +773,140 @@ def bench_flight_dump():
     }
 
 
+def bench_chaos(seed: int = 42) -> int:
+    """Seeded chaos storm (--chaos): the SAME fault schedule runs TWICE
+    against fresh 2-replica pools — a replica scheduler crash (nth
+    trigger) plus probabilistic dispatch delays — under a concurrent
+    greedy wave. The verdict (exit code, unlike the assertion-free
+    smokes) hard-fails on:
+
+      * a STUCK request (a collector thread still blocked after the
+        storm budget — the zero-leak contract);
+      * an ABORTED stream (failover must complete every greedy request
+        transparently: availability 1.0 is the SLO hard line);
+      * NONDETERMINISM — the two runs' token streams, terminal states,
+        and nth-mode injected-fault sequences must be identical
+        (prob-mode delay faults shape load and are excluded: their hit
+        counts ride thread timing by design).
+
+    docs/TESTING.md wires scripts/chaos.sh (this scenario) next to
+    scripts/analyze.sh as the pre-merge robustness gate."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu import faults
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.serving import ReplicaPool, ServingConfig
+
+    n_req, max_tokens = 8, 32
+    schedule = (
+        f"seed={seed};pool.scheduler_crash=nth:10;"
+        "dispatch.delay=prob:0.15,delay_ms=4"
+    )
+    cfg = TINY_TEST.scaled(name="chaos", max_context=256)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+
+    def run_once():
+        plan = faults.activate(schedule)
+        engines = [
+            TPUEngine(cfg, params, num_slots=2, max_context=256,
+                      cache_dtype=jnp.float32)
+            for _ in range(2)
+        ]
+        pool = ReplicaPool(
+            "chaos", engines,
+            lambda e: ContinuousBatcher(e, chunk_steps=2,
+                                        admit_chunk_steps=2),
+            ServingConfig(replicas=2, failover_retries=3),
+        )
+        streams: dict = {}
+        threads, handles = [], []
+        try:
+            for i in range(n_req):
+                h = pool.submit(
+                    Request(prompt_ids=[3 + i, 7, 11],
+                            max_tokens=max_tokens, temperature=0.0,
+                            request_id=f"chaos-{i}"),
+                    tenant=f"tenant-{i % 2}",
+                )
+                t = threading.Thread(
+                    target=lambda i=i, h=h: streams.__setitem__(
+                        i, h.tokens()
+                    ),
+                    daemon=True,
+                )
+                t.start()
+                handles.append(h)
+                threads.append(t)
+            stuck = 0
+            for t in threads:
+                t.join(timeout=180)
+                stuck += int(t.is_alive())
+        finally:
+            pool.shutdown()
+            faults.deactivate()
+        return {
+            "streams": [streams.get(i) for i in range(n_req)],
+            "states": ["aborted" if h.aborted else "done"
+                       for h in handles],
+            "stuck": stuck,
+            "aborted": sum(1 for h in handles if h.aborted),
+            "restarts": pool.restarts,
+            # the determinism fingerprint: schedule-determined (nth)
+            # faults only — prob-mode hit counts ride thread timing
+            "nth_faults": [
+                (f["point"], f["hit"]) for f in plan.journal()
+                if f["mode"] == "nth"
+            ],
+            "faults_total": len(plan.journal()),
+        }
+
+    a = run_once()
+    b = run_once()
+    complete = all(
+        s is not None and len(s) == max_tokens for s in a["streams"]
+    )
+    deterministic = (
+        a["streams"] == b["streams"]
+        and a["states"] == b["states"]
+        and a["nth_faults"] == b["nth_faults"]
+    )
+    stuck = a["stuck"] + b["stuck"]
+    aborted = a["aborted"] + b["aborted"]
+    ok = stuck == 0 and aborted == 0 and complete and deterministic
+    log(f"[chaos] seed={seed} restarts={a['restarts']}/{b['restarts']} "
+        f"faults={a['faults_total']}/{b['faults_total']} stuck={stuck} "
+        f"aborted={aborted} deterministic={deterministic} "
+        f"verdict={'PASS' if ok else 'FAIL'}")
+    emit({
+        "metric": "chaos storm (seeded crash + dispatch delay, "
+                  "2-replica pool, run twice)",
+        "value": 1.0 if ok else 0.0,
+        "unit": "verdict (1 = pass)",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "seed": seed,
+        "schedule": schedule,
+        "requests": n_req,
+        "stuck": stuck,
+        "aborted": aborted,
+        "availability": round(
+            1.0 - aborted / (2.0 * n_req), 4
+        ),
+        "replica_restarts": [a["restarts"], b["restarts"]],
+        "faults_injected": [a["faults_total"], b["faults_total"]],
+        "nth_fault_sequence": a["nth_faults"],
+        "deterministic": deterministic,
+        "streams_complete": complete,
+    })
+    return 0 if ok else 1
+
+
 def bench_dispatch():
     """Pipelined-decode A/B through the production continuous batcher
     (AIOS_TPU_DECODE_PIPELINE): 8 concurrent greedy requests per wave,
@@ -1409,7 +1543,27 @@ def main() -> int:
                          "2-replica pool wave whose request timelines "
                          "are dumped as Chrome trace JSON + SLO summary "
                          "(assertion-free, always exit 0)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run ONLY the seeded chaos storm (crash + "
+                         "dispatch-delay faults on a 2-replica pool, "
+                         "run twice): exit NON-ZERO on any stuck "
+                         "request, aborted stream, or nondeterministic "
+                         "re-run — the pre-merge robustness gate "
+                         "(scripts/chaos.sh, docs/FAULTS.md)")
+    ap.add_argument("--chaos-seed", type=int, default=42, metavar="N",
+                    help="fault-schedule seed for --chaos (default 42)")
     args = ap.parse_args()
+
+    if args.chaos:
+        try:
+            return bench_chaos(args.chaos_seed)
+        except Exception as e:  # a crashed harness is a FAIL, loudly
+            log(f"[chaos] HARNESS FAILED: {e!r}")
+            emit({"metric": "chaos storm (seeded crash + dispatch "
+                            "delay, 2-replica pool, run twice)",
+                  "value": 0.0, "unit": "verdict (1 = pass)",
+                  "vs_baseline": 0.0, "error": repr(e)[:300]})
+            return 1
 
     if args.flight_dump:
         try:

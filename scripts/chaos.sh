@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Seeded chaos storm against a live 2-replica pool — the pre-merge
+# robustness gate (docs/FAULTS.md, docs/TESTING.md), the fault-tolerance
+# sibling of scripts/analyze.sh.
+#
+# Runs bench.py --chaos: the SAME seeded fault schedule (replica
+# scheduler crash + probabilistic dispatch delays) twice against fresh
+# pools under a concurrent greedy wave. Exit is NON-ZERO on any stuck
+# request, any aborted stream (transparent failover must complete every
+# greedy request), or a nondeterministic re-run (token streams, terminal
+# states, and the nth-mode injected-fault sequence must be identical).
+#
+# Usage:
+#   scripts/chaos.sh                 # default seed (42)
+#   scripts/chaos.sh --seed 7        # a different storm
+#   CHAOS_SEED=7 scripts/chaos.sh    # same, env-style for CI matrices
+#
+# Reading a failure: the JSON line on stdout carries stuck/aborted
+# counts + the nth fault sequence; the flight recorder's crash_respawn
+# snapshot (GET /debug/snapshots on a live deployment, or the
+# AIOS_TPU_FLIGHTREC_DUMP_DIR files) holds the per-request timelines.
+# docs/RUNBOOK.md "chaos drill" walks the live-pool version.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+seed="${CHAOS_SEED:-42}"
+if [[ "${1:-}" == "--seed" && -n "${2:-}" ]]; then
+  seed="$2"
+fi
+
+exec python bench.py --chaos --chaos-seed "$seed"

@@ -306,6 +306,90 @@ def test_jit_warmup_waiver_honored():
     assert not _unwaived(_analyze(src, reg), "jit-warmup")
 
 
+# -- rule: silent-except (ISSUE 10) ------------------------------------------
+
+def _se_registry():
+    r = _registry()
+    r.silent_except_prefixes = (FIX,)
+    return r
+
+
+SILENT_SRC = """
+    class Pool:
+        def cleanup(self):
+            try:
+                self.batcher.shutdown()
+            except Exception:
+                pass
+"""
+
+
+def test_silent_except_fires_on_swallowed_broad_handler():
+    found = _unwaived(_analyze(SILENT_SRC, _se_registry()), "silent-except")
+    assert len(found) == 1
+    assert "black hole" in found[0].message
+
+
+def test_silent_except_waiver_honored():
+    waived = SILENT_SRC.replace(
+        "except Exception:",
+        "except Exception:  # aios: waive(silent-except): fixture rationale",
+    )
+    assert not _unwaived(_analyze(waived, _se_registry()), "silent-except")
+
+
+@pytest.mark.parametrize("body", [
+    "raise",
+    "log.exception('boom')",
+    "log.warning('boom %s', exc)",
+    "self._abort_all(exc)",
+    "live.abort_reason = 'evicted: boom'",
+    "self._finish(live, abort_reason='boom')",
+    "context.abort(code, 'boom')",
+])
+def test_silent_except_recording_handlers_are_clean(body):
+    src = f"""
+        class Pool:
+            def cleanup(self):
+                try:
+                    self.batcher.shutdown()
+                except Exception as exc:
+                    {body}
+    """
+    assert not _unwaived(_analyze(src, _se_registry()), "silent-except")
+
+
+def test_silent_except_bare_and_tuple_handlers_count_as_broad():
+    src = """
+        class Pool:
+            def a(self):
+                try:
+                    work()
+                except:
+                    pass
+
+            def b(self):
+                try:
+                    work()
+                except (ValueError, BaseException):
+                    pass
+
+            def c(self):
+                try:
+                    work()
+                except ValueError:
+                    pass  # narrow: not this rule's business
+    """
+    found = _unwaived(_analyze(src, _se_registry()), "silent-except")
+    assert len(found) == 2
+
+
+def test_silent_except_scoped_to_registry_prefixes():
+    """A module outside the declared prefixes is not checked — the rule
+    polices the serving plane, not every utility script."""
+    assert not _unwaived(_analyze(SILENT_SRC, _registry()), "silent-except")
+
+
 # -- rule 5: knob drift + metric catalog -------------------------------------
 
 def test_knob_docs_missing_knob_fires_and_waives():

@@ -171,6 +171,23 @@ def test_serving_env_from_boot_config(tmp_path):
     zero.write_text("[models]\nmax_queue = 0\n")
     assert serving_env(load_config(str(zero)))["AIOS_TPU_MAX_QUEUE"] == "0"
 
+    # failover knobs forward, and an EXPLICIT retries = 0 means OFF
+    # (overriding the serving default of 2); [faults] arms the
+    # fault-injection schedule with its seed prepended (docs/FAULTS.md)
+    chaos = tmp_path / "chaos.toml"
+    chaos.write_text(
+        "[models]\n"
+        "failover_retries = 0\n"
+        "failover_backoff_ms = 25\n"
+        "[faults]\n"
+        "schedule = \"pool.scheduler_crash=nth:3\"\n"
+        "seed = 7\n"
+    )
+    env = serving_env(load_config(str(chaos)))
+    assert env["AIOS_TPU_FAILOVER_RETRIES"] == "0"
+    assert env["AIOS_TPU_FAILOVER_BACKOFF_MS"] == "25"
+    assert env["AIOS_TPU_FAULTS"] == "seed=7;pool.scheduler_crash=nth:3"
+
     # defaults: the paged pool + prefix cache default ON ("auto" sizing);
     # no other knob is injected (AiosConfig() directly; load_config(None)
     # would read this HOST's /etc/aios config)

@@ -1,0 +1,807 @@
+"""Chaos-hardened serving plane (ISSUE 10): deterministic fault
+injection + transparent in-flight failover.
+
+Fast tier: the injection layer's units (schedule grammar, trigger
+semantics, the seeded-determinism contract, journal/metric plumbing),
+the host store's crc32 integrity, the allocator-pressure and clock-skew
+points, the gateway client's retry-after honoring, and THE failover
+acceptance (a replica crash injected mid-decode on a 2-replica pool
+completes every in-flight greedy request token-identically, with
+``failover`` timeline events and zero stuck requests).
+
+Slow tier: the engine-level restore-failure fallback (the PR 4 path,
+now provokable on demand), host-tier corruption detection end to end,
+and the budget-exhaust -> UNAVAILABLE + retry-after surface over live
+gRPC.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from aios_tpu import faults
+from aios_tpu.faults.inject import _parse
+from aios_tpu.obs import flightrec
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with no schedule armed — a leaked plan
+    would inject faults into unrelated tests."""
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# schedule grammar + trigger semantics (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_schedule_grammar():
+    sched, seed = _parse(
+        "seed=42;pool.scheduler_crash=nth:3;"
+        "dispatch.delay=prob:0.25,delay_ms=20;"
+        "admission.clock_skew=after:5,skew_ms=2000"
+    )
+    assert seed == 42
+    assert sched["pool.scheduler_crash"].mode == "nth"
+    assert sched["pool.scheduler_crash"].arg == 3
+    assert sched["dispatch.delay"].params == {"delay_ms": 20}
+    assert sched["admission.clock_skew"].params == {"skew_ms": 2000}
+
+
+def test_parse_is_lenient():
+    """Malformed entries drop with a warning — a typo'd chaos knob must
+    not take down a boot (the env-parser convention)."""
+    sched, seed = _parse(
+        "seed=oops;no.such.point=nth:1;pool.scheduler_crash=never:1;"
+        "dispatch.delay=nth:x;host_store.corrupt=nth:2,bad=param;"
+        "rpc.unavailable=nth:1"
+    )
+    assert seed == 0
+    assert list(sched) == ["rpc.unavailable"]
+
+
+def test_nth_trigger_fires_exactly_once():
+    plan = faults.activate("pool.scheduler_crash=nth:3")
+    hits = [faults.point("pool.scheduler_crash") for _ in range(6)]
+    fired = [a for a in hits if a is not None]
+    assert len(fired) == 1
+    assert hits[2] is not None and fired[0].hit == 3
+    assert plan.journal() == [{
+        "point": "pool.scheduler_crash", "mode": "nth", "hit": 3,
+        "model": "",
+    }]
+
+
+def test_prob_trigger_is_a_pure_function_of_seed_and_hit_index():
+    """THE determinism contract: the k-th hit's fire decision depends
+    only on (seed, point, k) — the same seed + schedule + call pattern
+    reproduce the identical injected-fault sequence."""
+    def run(seed):
+        faults.activate(f"seed={seed};dispatch.delay=prob:0.4")
+        return [
+            faults.point("dispatch.delay") is not None for _ in range(64)
+        ]
+
+    a, b, other = run(11), run(11), run(12)
+    assert a == b
+    assert a != other  # a different seed is a different storm
+    assert any(a) and not all(a)
+
+
+def test_per_point_rngs_are_independent():
+    """Interleaving a second point's hits must not perturb the first
+    point's decisions (per-point PRNGs seeded by (seed, point))."""
+    faults.activate("seed=5;dispatch.delay=prob:0.4")
+    alone = [faults.point("dispatch.delay") is not None for _ in range(32)]
+    faults.activate(
+        "seed=5;dispatch.delay=prob:0.4;rpc.unavailable=prob:0.4"
+    )
+    mixed = []
+    for _ in range(32):
+        mixed.append(faults.point("dispatch.delay") is not None)
+        faults.point("rpc.unavailable")
+    assert mixed == alone
+
+
+def test_after_trigger_gates_on_elapsed_time():
+    plan = faults.activate("admission.clock_skew=after:30,skew_ms=500")
+    assert faults.point("admission.clock_skew") is None
+    plan.activated_at -= 31  # fast-forward the drill clock
+    act = faults.point("admission.clock_skew")
+    assert act is not None and act.skew_s == 0.5
+
+
+def test_disabled_point_is_none_with_no_side_effects():
+    assert not faults.active()
+    assert faults.point("pool.scheduler_crash") is None
+    assert faults.fired() == []
+
+
+def test_fired_fault_counts_metric_and_records_model_event():
+    from aios_tpu.obs import instruments as obs
+
+    child = obs.FAULTS_INJECTED.labels(
+        point="allocator.pressure", mode="nth"
+    )
+    before = child.value
+    faults.activate("allocator.pressure=nth:1")
+    assert faults.point("allocator.pressure", "faultmodel") is not None
+    assert child.value == before + 1
+    events = [
+        (m, kind, f)
+        for _, m, kind, f in flightrec.RECORDER.model_events("faultmodel")
+        if kind == "fault"
+    ]
+    assert events and events[-1][2]["point"] == "allocator.pressure"
+
+
+def test_activate_seed_override_and_env_install(monkeypatch):
+    plan = faults.activate("dispatch.delay=prob:0.5", seed=99)
+    assert plan.seed == 99
+    monkeypatch.setenv("AIOS_TPU_FAULTS", "seed=3;rpc.unavailable=nth:1")
+    faults.install_from_env()
+    assert faults.active()
+    assert faults.point("rpc.unavailable") is not None
+    monkeypatch.setenv("AIOS_TPU_FAULTS", "")
+    faults.install_from_env()
+    assert not faults.active()
+
+
+# ---------------------------------------------------------------------------
+# injection points: allocator pressure + clock skew (fast, no jit)
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_pressure_point_raises_pool_exhausted():
+    from aios_tpu.engine.paged import PageAllocator, PoolExhausted
+
+    a = PageAllocator(num_pages=8, page_size=16, num_slots=2, max_blocks=4)
+    a.ensure(0, 16)  # sanity: works un-faulted
+    faults.activate("allocator.pressure=nth:1")
+    with pytest.raises(PoolExhausted):
+        a.ensure(1, 16)
+    a.ensure(1, 16)  # one-shot: the pool recovers
+
+
+def test_clock_skew_point_drives_deadline_sheds():
+    from aios_tpu.serving.admission import AdmissionController, AdmissionError
+    from aios_tpu.serving.config import ServingConfig
+
+    adm = AdmissionController(ServingConfig(), "skewmodel")
+    # feasible: 100 tokens at 100 tok/s inside a 10 s deadline
+    adm.check_deadline(10.0, 0, 100, 100.0)
+    faults.activate("admission.clock_skew=nth:1,skew_ms=9500")
+    with pytest.raises(AdmissionError) as err:
+        adm.check_deadline(10.0, 0, 100, 100.0)
+    assert err.value.cause == "deadline"
+    adm.check_deadline(10.0, 0, 100, 100.0)  # one-shot
+
+
+# ---------------------------------------------------------------------------
+# host store crc32 integrity (fast, pure numpy)
+# ---------------------------------------------------------------------------
+
+
+def test_store_corruption_detected_and_dropped():
+    from aios_tpu.engine.paged import HostPageStore
+
+    s = HostPageStore(max_bytes=1 << 20)
+    for h in (b"a", b"b", b"c"):
+        s.put(h, {"k": np.arange(64, dtype=np.int8),
+                  "v": np.arange(64, dtype=np.int8)})
+    # silent bit-rot (no fault layer): flip a stored byte by hand
+    s._entries[b"b"]["k"][3] ^= 1
+    got = s.match_chain([b"a", b"b", b"c"])
+    assert [h for h, _ in got] == [b"a"]  # chain truncates at the rot
+    assert s.corruptions == 1
+    assert s.peek_chain([b"b"]) == 0  # dropped, not served again
+    assert s.peek_chain([b"c"]) == 1  # innocent bystander survives
+
+
+def test_store_corrupt_fault_point_drives_the_detection_path():
+    from aios_tpu.engine.paged import HostPageStore
+
+    s = HostPageStore(max_bytes=1 << 20)
+    s.put(b"a", {"k": np.zeros(64, np.int8), "v": np.zeros(64, np.int8)})
+    faults.activate("host_store.corrupt=nth:1")
+    assert s.match_chain([b"a"]) == []
+    assert s.corruptions == 1 and s.misses == 1
+    assert len(s) == 0
+
+
+def test_store_failed_restore_counts_a_miss():
+    from aios_tpu.engine.paged import HostPageStore
+
+    s = HostPageStore(max_bytes=1 << 20)
+    s.put(b"a", {"k": np.zeros(8, np.int8), "v": np.zeros(8, np.int8)})
+    assert len(s.match_chain([b"a"])) == 1
+    assert (s.hits, s.misses) == (1, 0)
+    s.note_failed_restore()
+    assert (s.hits, s.misses) == (1, 1)
+
+
+# ---------------------------------------------------------------------------
+# gateway client honors retry-after (fast, fake stub)
+# ---------------------------------------------------------------------------
+
+
+class _FakeRpcError(Exception):
+    def __init__(self, code, trailing=()):
+        self._code = code
+        self._trailing = tuple(trailing)
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return "fake"
+
+    def trailing_metadata(self):
+        return self._trailing
+
+
+def _mk_fake_error(code, trailing=()):
+    import grpc
+
+    # a real grpc.RpcError subclass so the client's except clause matches
+    err = _FakeRpcError.__new__(
+        type("FakeRpcError", (grpc.RpcError,), dict(_FakeRpcError.__dict__))
+    )
+    err.__init__(code, trailing)
+    return err
+
+
+def test_gateway_client_retries_on_retry_after(monkeypatch):
+    import grpc
+
+    from aios_tpu.gateway.providers import LocalRuntimeClient
+
+    client = LocalRuntimeClient(address="127.0.0.1:1")
+    calls = {"n": 0}
+
+    class _Resp:
+        text = "ok"
+        tokens_used = 3
+        model_used = "tiny"
+
+    class _Stub:
+        def Infer(self, request, timeout):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise _mk_fake_error(
+                    grpc.StatusCode.UNAVAILABLE,
+                    (("retry-after-ms", "5"),),
+                )
+            return _Resp()
+
+    slept = []
+    monkeypatch.setattr(client, "_get_stub", lambda: _Stub())
+    monkeypatch.setattr(
+        LocalRuntimeClient, "_backoff",
+        staticmethod(lambda ms: slept.append(ms)),
+    )
+    out = client.infer("p", "s", 16, 0.0)
+    assert out.text == "ok" and calls["n"] == 3
+    assert slept == [5, 5]  # honored the hint on both failures
+
+
+def test_gateway_client_no_hint_fails_fast(monkeypatch):
+    import grpc
+
+    from aios_tpu.gateway.providers import LocalRuntimeClient, ProviderError
+
+    client = LocalRuntimeClient(address="127.0.0.1:1")
+    calls = {"n": 0}
+
+    class _Stub:
+        def Infer(self, request, timeout):
+            calls["n"] += 1
+            raise _mk_fake_error(grpc.StatusCode.NOT_FOUND)
+
+    monkeypatch.setattr(client, "_get_stub", lambda: _Stub())
+    with pytest.raises(ProviderError):
+        client.infer("p", "s", 16, 0.0)
+    assert calls["n"] == 1  # no blind retry without the hint
+
+
+def test_gateway_client_bounded_attempts(monkeypatch):
+    import grpc
+
+    from aios_tpu.gateway.providers import LocalRuntimeClient, ProviderError
+
+    monkeypatch.setenv("AIOS_TPU_RUNTIME_RETRY_ATTEMPTS", "1")
+    client = LocalRuntimeClient(address="127.0.0.1:1")
+    calls = {"n": 0}
+
+    class _Stub:
+        def Infer(self, request, timeout):
+            calls["n"] += 1
+            raise _mk_fake_error(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                (("retry-after-ms", "1"),),
+            )
+
+    monkeypatch.setattr(client, "_get_stub", lambda: _Stub())
+    monkeypatch.setattr(
+        LocalRuntimeClient, "_backoff", staticmethod(lambda ms: None)
+    )
+    with pytest.raises(ProviderError):
+        client.infer("p", "s", 16, 0.0)
+    assert calls["n"] == 2  # 1 try + 1 retry, then surface
+
+
+# ---------------------------------------------------------------------------
+# no pycache-only package dirs (the orphan that squatted on faults/)
+# ---------------------------------------------------------------------------
+
+
+def test_no_pycache_only_package_dirs():
+    """A directory under aios_tpu/ whose only content is __pycache__ is
+    a ghost package: stale bytecode squatting on a name (the pre-PR-10
+    state of aios_tpu/faults/)."""
+    from pathlib import Path
+
+    import aios_tpu
+
+    root = Path(aios_tpu.__file__).parent
+    for cache in root.rglob("__pycache__"):
+        siblings = [p for p in cache.parent.iterdir()
+                    if p.name != "__pycache__"]
+        assert siblings, (
+            f"{cache.parent} contains ONLY __pycache__ — delete the "
+            f"stale bytecode or give the package sources"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 2-replica pool: THE failover acceptance (fast tier — tiny engines)
+# ---------------------------------------------------------------------------
+
+
+MODEL = "failover-test"
+
+
+@pytest.fixture(scope="module")
+def crash_pool():
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.serving import ReplicaPool, ServingConfig
+
+    cfg = TINY_TEST.scaled(name=MODEL, max_context=256)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    engines = [
+        TPUEngine(cfg, params, num_slots=2, max_context=256,
+                  cache_dtype=jnp.float32)
+        for _ in range(2)
+    ]
+    pool = ReplicaPool(
+        MODEL, engines,
+        lambda e: ContinuousBatcher(e, chunk_steps=2, admit_chunk_steps=2),
+        ServingConfig(replicas=2, failover_retries=2),
+    )
+    yield pool
+    pool.shutdown()
+
+
+def _wave(pool, tag, n=4, max_tokens=24):
+    from aios_tpu.engine.batching import Request
+
+    handles = [
+        pool.submit(
+            Request(prompt_ids=[3 + i, 7, 11], max_tokens=max_tokens,
+                    temperature=0.0, request_id=f"{tag}-{i}"),
+            tenant="chaos-tenant",
+        )
+        for i in range(n)
+    ]
+    streams = {}
+    threads = []
+    for i, h in enumerate(handles):
+        t = threading.Thread(
+            target=lambda i=i, h=h: streams.__setitem__(i, h.tokens()),
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+    stuck = 0
+    for t in threads:
+        t.join(timeout=120)
+        stuck += int(t.is_alive())
+    return [streams.get(i) for i in range(n)], handles, stuck
+
+
+def test_failover_crash_mid_decode_streams_identical(crash_pool):
+    """ISSUE 10 acceptance: a replica crash injected mid-decode on a
+    2-replica pool completes every in-flight greedy request with a token
+    stream identical to a fault-free run, zero stuck requests,
+    ``failover`` timeline events, and a counted respawn — the client
+    never sees the crash."""
+    pool = crash_pool
+    ref, ref_handles, stuck = _wave(pool, "ref")
+    assert stuck == 0 and all(len(s) == 24 for s in ref)
+    assert not any(h.aborted for h in ref_handles)
+
+    restarts_before = pool.restarts
+    faults.activate("seed=2;pool.scheduler_crash=nth:6")
+    try:
+        out, handles, stuck = _wave(pool, "crash")
+    finally:
+        faults.deactivate()
+    assert stuck == 0, "a request leaked through the crash"
+    assert out == ref, "failover streams must be token-identical"
+    assert not any(h.aborted for h in handles)
+    assert pool.restarts == restarts_before + 1
+    # the timelines carry the failover story: at least one request
+    # crossed replicas, every one retired normally
+    tls = [
+        t for t in flightrec.RECORDER.recent(model=MODEL, limit=64)
+        if t.request_id.startswith("crash-")
+    ]
+    assert len(tls) == 4
+    assert all(t.state == "retired" for t in tls)
+    fo = [t for t in tls
+          if any(k == "failover" for _, k, _ in t.events)]
+    assert fo, "no failover event recorded on any timeline"
+    ev = next(
+        f for t in fo for _, k, f in t.events if k == "failover"
+    )
+    assert ev["cause"] == "scheduler_failed" and ev["attempt"] == 1
+    # tokens_out accumulated across attempts == what the client got
+    assert all(t.tokens_out == 24 for t in tls)
+
+
+def test_failover_budget_exhausts_as_retryable_abort(crash_pool):
+    """Every retry crashes (prob:1.0): the abort surfaces with a
+    retry-after hint — UNAVAILABLE at the service mapping — and the
+    timeline finishes aborted with the failover attempts on record."""
+    pool = crash_pool
+    faults.activate("seed=3;pool.scheduler_crash=prob:1.0")
+    try:
+        out, handles, stuck = _wave(pool, "exhaust", n=2)
+    finally:
+        faults.deactivate()
+    assert stuck == 0
+    assert all(h.aborted for h in handles)
+    assert all(h.retry_after_ms > 0 for h in handles), (
+        "an exhausted failover budget must hand the client a backoff "
+        "hint, not a dead end"
+    )
+    assert all("scheduler" in h.abort_reason for h in handles)
+    tls = [
+        t for t in flightrec.RECORDER.recent(model=MODEL, limit=64)
+        if t.request_id.startswith("exhaust-")
+    ]
+    assert len(tls) == 2
+    assert all(t.state == "aborted" for t in tls)
+    assert all(t.abort_cause == "scheduler_failed" for t in tls)
+    for t in tls:
+        assert sum(
+            1 for _, k, _ in t.events if k == "failover"
+        ) == 2, "both budget attempts must be on the record"
+
+
+def test_cancel_after_claimed_abort_finishes_timeline(crash_pool):
+    """A crash and a client disconnect are correlated (the stalled
+    stream is why the client gives up): when the batcher deferred the
+    terminal event to the failover controller and the consumer then
+    cancels instead of resuming, the timeline must still finish — no
+    request may vanish with no terminal event, ring entry, or SLO
+    sample."""
+    from aios_tpu.engine.batching import Request
+
+    pool = crash_pool
+    faults.activate("seed=5;pool.scheduler_crash=prob:1.0")
+    try:
+        h = pool.submit(
+            Request(prompt_ids=[9, 8, 7], max_tokens=24, temperature=0.0,
+                    request_id="orphan-1"),
+            tenant="chaos-tenant",
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline and not h._inner._live.abort_reason:
+            time.sleep(0.02)
+        assert h._inner._live.abort_reason, "the crash never landed"
+        h.cancel()  # the client gave up without consuming the stream
+    finally:
+        faults.deactivate()
+    tls = [
+        t for t in flightrec.RECORDER.recent(model=MODEL, limit=64)
+        if t.request_id == "orphan-1"
+    ]
+    assert tls, "the claimed timeline was never finished into the ring"
+    assert tls[0].state == "aborted"
+
+
+def test_faults_disabled_streams_and_compiles_pinned(crash_pool):
+    """The PR 6/7/8 invariant extended to the instrumented hot paths:
+    with no schedule armed, the same wave twice is token-identical, no
+    fault fires, and the engines compile NOTHING new (the injection
+    points are no-ops, not graph changes)."""
+    pool = crash_pool
+    a, _, _ = _wave(pool, "quiet-a")
+    compiles = [r.engine.stats()["xla_compiles"] for r in pool.replicas]
+    b, _, _ = _wave(pool, "quiet-b")
+    assert a == b
+    assert faults.fired() == []
+    assert [
+        r.engine.stats()["xla_compiles"] for r in pool.replicas
+    ] == compiles
+
+
+def test_constrained_requests_are_not_wrapped(crash_pool):
+    """json_mode/json_schema requests keep the plain handle (a resume
+    cannot reproduce the grammar-forced first token) — they abort with
+    a retryable status instead of failing over."""
+    from aios_tpu.engine.batching import Request, RequestHandle
+
+    pool = crash_pool
+    req = Request(prompt_ids=[5, 6, 7], max_tokens=4, temperature=0.0,
+                  json_mode=True, request_id="constrained-1")
+    # the pool refuses to wrap; whether submit succeeds depends on the
+    # tokenizer (TINY_TEST batchers have none), and THAT error must
+    # surface on the caller, not a failover controller
+    try:
+        h = pool.submit(req, tenant="chaos-tenant")
+    except ValueError:
+        assert req.failover is None
+        return
+    assert isinstance(h, RequestHandle)
+    assert req.failover is None
+    h.cancel()
+
+
+def test_evicted_not_retryable_on_single_replica_pool():
+    """A 1-replica pool must not re-route an eviction back onto the
+    replica that just evicted it — only scheduler crashes retry."""
+    from aios_tpu.serving.failover import FailoverHandle
+
+    class _Pool:
+        replicas = [object()]
+        name = "one"
+        _draining = False
+        _closed = False
+
+    fo = FailoverHandle(_Pool(), None, "t", retries=2, backoff_ms=1.0)
+    assert fo.claims("scheduler failed: boom")
+    assert not fo.claims("evicted: KV pool exhausted")
+    assert not fo.claims("model unloading")
+
+    class _Pool2(_Pool):
+        replicas = [object(), object()]
+
+    fo2 = FailoverHandle(_Pool2(), None, "t", retries=2, backoff_ms=1.0)
+    assert fo2.claims("evicted: KV pool exhausted")
+
+
+def test_failover_handle_cancel_stops_retries():
+    from aios_tpu.serving.failover import FailoverHandle
+
+    class _Pool:
+        replicas = [object(), object()]
+        name = "c"
+        _draining = False
+        _closed = False
+
+    fo = FailoverHandle(_Pool(), None, "t", retries=2, backoff_ms=1.0)
+    fo.cancel()
+    assert not fo.claims("scheduler failed: boom")
+
+
+# ---------------------------------------------------------------------------
+# engine-level restore fallback + corruption (slow tier — real spills)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model
+
+    from aios_tpu.engine.config import TINY_TEST
+
+    return model.init_params(TINY_TEST, jax.random.PRNGKey(1),
+                             dtype=jnp.float32)
+
+
+def make_engine(params, host_bytes=64 << 20, **kw):
+    import jax.numpy as jnp
+
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_context", 256)
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("paged_pool_rows", 256)
+    kw.setdefault("page_size", 32)
+    return TPUEngine(TINY_TEST, params, prefix_host_bytes=host_bytes, **kw)
+
+
+def _force_spill(eng, rng, min_entries=2, blocks=6):
+    pressure = [int(t) for t in rng.integers(1, 500, blocks * 32 + 8)]
+    eng.prefill(0, pressure, temperature=0.0)
+    eng.release(0)
+    deadline = time.time() + 20
+    while (len(eng.host_store) < min_entries or eng._spill_pending) \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(eng.host_store) >= min_entries, "spill worker never drained"
+    assert eng._spill_pending == 0
+
+
+def _assert_page_invariants(eng):
+    """No page simultaneously free-listed and mapped/indexed (the PR 4
+    interleaving invariant, asserted after every faulted run)."""
+    alloc = eng.allocator
+    free = set(alloc._free[0])
+    indexed = set(eng.prefix_index.snapshot().values())
+    mapped = set()
+    for s in range(eng.num_slots):
+        used = int(alloc._blocks_used[s])
+        mapped.update(int(p) for p in alloc.tables[s, :used])
+    assert not (free & indexed), (free, indexed)
+    assert not (free & mapped), (free, mapped)
+    for p in free:
+        assert alloc.refcount(p) == 0
+
+
+@pytest.mark.slow
+def test_restore_fail_falls_back_to_prefill_token_identical(params):
+    """ISSUE 10 satellite: fault-inject ``host_store.restore_fail`` and
+    the engine falls back to normal prefill with token-identical output,
+    the failed restore counted as a host-tier miss, nothing restored,
+    and no page leaked between the free list and the tables."""
+    rng = np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]
+    eng = make_engine(params)
+    ref = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    _force_spill(eng, rng)
+    misses0 = eng.host_store.misses
+    hits0 = eng.host_store.hits
+    faults.activate("host_store.restore_fail=nth:1")
+    try:
+        again = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    finally:
+        faults.deactivate()
+    assert again == ref  # fallback prefill, token-identical
+    assert eng.prefix_rows_restored == 0  # the restore never happened
+    assert eng.host_store.hits == hits0 + 1  # the probe DID hit
+    assert eng.host_store.misses == misses0 + 1, (
+        "a failed restore must count as a miss — "
+        "aios_tpu_prefix_host_misses_total is the recompute predictor"
+    )
+    assert eng.stats()["host_tier_misses"] == eng.host_store.misses
+    # the fallback prefill re-registered the blocks in the HBM index:
+    # the NEXT submit is a plain prefix hit — no restore, no recompute
+    reused0 = eng.prefix_rows_reused
+    third = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    assert third == ref
+    assert eng.prefix_rows_reused > reused0
+    assert eng.prefix_rows_restored == 0
+    _assert_page_invariants(eng)
+    eng.close()
+
+
+@pytest.mark.slow
+def test_corrupt_spill_detected_end_to_end(params):
+    """``host_store.corrupt`` flips a spilled byte; the crc32 check at
+    the restore probe drops the page, the prompt recomputes token-
+    identically, and the corruption is counted (engine stats +
+    aios_tpu_prefix_host_corrupt_total plumbing)."""
+    rng = np.random.default_rng(8)
+    prompt = [int(t) for t in rng.integers(1, 500, 100)]
+    eng = make_engine(params)
+    ref = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    _force_spill(eng, rng)
+    faults.activate("host_store.corrupt=nth:1")
+    try:
+        again = eng.generate(prompt, max_new_tokens=16, temperature=0.0)
+    finally:
+        faults.deactivate()
+    assert again == ref
+    assert eng.host_store.corruptions == 1
+    assert eng.stats()["host_tier_corrupt"] == 1
+    _assert_page_invariants(eng)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# gRPC surface: crash aborts are retryable; rpc.unavailable injects
+# (slow tier — live server)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_crash_abort_surfaces_unavailable_with_retry_after(monkeypatch):
+    """ISSUE 10 satellite: a crash that exhausts the failover budget
+    reaches the client as UNAVAILABLE + retry-after-ms trailing
+    metadata (the admission-shed convention), never a truncated stream
+    presented as a completion."""
+    import grpc as grpc_mod
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    monkeypatch.delenv("AIOS_TPU_REPLICAS", raising=False)
+    monkeypatch.setenv("AIOS_TPU_FAILOVER_RETRIES", "1")
+    monkeypatch.setenv("AIOS_TPU_FAILOVER_BACKOFF_MS", "5")
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    mgr.load_model("crashtiny", "synthetic://tiny-test",
+                   context_length=128)
+    server, _, port = serve(address="127.0.0.1:0", manager=mgr,
+                            block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = services.AIRuntimeStub(channel)
+        # warm the path un-faulted so the crash lands mid-decode
+        stub.Infer(runtime_pb2.InferRequest(
+            prompt="warm", max_tokens=4, temperature=0.0
+        ))
+        faults.activate("seed=4;pool.scheduler_crash=prob:1.0")
+        with pytest.raises(grpc_mod.RpcError) as err:
+            stub.Infer(runtime_pb2.InferRequest(
+                prompt="hello", max_tokens=64, temperature=0.0
+            ))
+        faults.deactivate()
+        assert err.value.code() == grpc_mod.StatusCode.UNAVAILABLE
+        md = dict(err.value.trailing_metadata() or ())
+        assert int(md.get("retry-after-ms", 0)) > 0
+        # and the pool recovers: the next request serves normally
+        resp = stub.Infer(runtime_pb2.InferRequest(
+            prompt="after", max_tokens=4, temperature=0.0
+        ))
+        assert resp.tokens_used > 0
+    finally:
+        faults.deactivate()
+        channel.close()
+        server.stop(grace=None)
+        mgr.unload_model("crashtiny")
+
+
+@pytest.mark.slow
+def test_rpc_unavailable_point_aborts_with_retry_after(monkeypatch):
+    """The rpc.unavailable point makes ANY server RPC abort UNAVAILABLE
+    + retry-after-ms — the injected shape of a process mid-restart —
+    and service resumes on the next call."""
+    import grpc as grpc_mod
+
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import common_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    monkeypatch.delenv("AIOS_TPU_REPLICAS", raising=False)
+    mgr = ModelManager(num_slots=2, warm_compile=False)
+    server, _, port = serve(address="127.0.0.1:0", manager=mgr,
+                            block=False)
+    channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = services.AIRuntimeStub(channel)
+        stub.HealthCheck(common_pb2.Empty())  # un-faulted: serves
+        faults.activate("rpc.unavailable=nth:1,retry_after_ms=250")
+        with pytest.raises(grpc_mod.RpcError) as err:
+            stub.HealthCheck(common_pb2.Empty())
+        faults.deactivate()
+        assert err.value.code() == grpc_mod.StatusCode.UNAVAILABLE
+        md = dict(err.value.trailing_metadata() or ())
+        assert md.get("retry-after-ms") == "250"
+        stub.HealthCheck(common_pb2.Empty())  # one-shot: recovered
+    finally:
+        faults.deactivate()
+        channel.close()
+        server.stop(grace=None)

@@ -89,6 +89,7 @@ SERVING_EXPECTED = {
     "aios_tpu_serving_quota_rejections_total": "counter",
     "aios_tpu_serving_queue_wait_seconds": "histogram",
     "aios_tpu_serving_replica_restarts_total": "counter",
+    "aios_tpu_serving_failover_total": "counter",
 }
 
 
@@ -111,6 +112,7 @@ PREFIX_HOST_EXPECTED = {
     "aios_tpu_prefix_host_restores_total": "gauge",
     "aios_tpu_prefix_host_hits_total": "gauge",
     "aios_tpu_prefix_host_misses_total": "gauge",
+    "aios_tpu_prefix_host_corrupt_total": "gauge",
     "aios_tpu_prefix_host_restore_seconds": "histogram",
 }
 
@@ -328,15 +330,18 @@ def _call_site_kinds(*modules):
 
 def test_recorder_event_kinds_bounded():
     """Every event-kind string at every recorder call site — batcher,
-    pool, engine, runtime service, and flightrec itself — is a member of
-    the closed flightrec.EVENT_KINDS enum."""
+    pool, engine, runtime service, the failover controller, the fault
+    injector, and flightrec itself — is a member of the closed
+    flightrec.EVENT_KINDS enum."""
     from aios_tpu.engine import batching, engine as engine_mod
+    from aios_tpu.faults import inject as faults_inject
     from aios_tpu.obs import flightrec
     from aios_tpu.runtime import service as runtime_service
-    from aios_tpu.serving import pool
+    from aios_tpu.serving import failover, pool
 
     kinds = _call_site_kinds(
-        batching, engine_mod, pool, runtime_service, flightrec
+        batching, engine_mod, pool, runtime_service, flightrec,
+        failover, faults_inject,
     )
     assert kinds, "no recorder event call sites found"
     unknown = kinds - set(flightrec.EVENT_KINDS)
@@ -402,6 +407,52 @@ def test_abort_reasons_normalize_onto_closed_enum():
             f"abort_reason {reason!r} falls into the catch-all bucket; "
             f"extend flightrec.abort_cause/ABORT_CAUSES"
         )
+
+
+def test_faults_family_complete_and_typed():
+    """The fault-injection instrument the ISSUE 10 catalog promises:
+    one counter, labeled (point, mode), both drawn from the closed
+    faults.POINTS / faults.MODES enums — a fired fault must never mint
+    a free-form label value."""
+    from aios_tpu import faults
+
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_faults_")
+    }
+    assert family == {"aios_tpu_faults_injected_total": "counter"}
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_faults_"):
+            assert tuple(m.labelnames) == ("point", "mode")
+    # the only strings handed to the point label come from the catalog:
+    # FaultPlan.check validates the name against the parsed schedule,
+    # whose keys _parse restricts to faults.POINTS
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu.faults import inject
+
+    mi = module_info_for(inject)
+    assert "POINTS" in names_used_in(mi.functions["_parse"].node)
+    assert set(faults.MODES) == {"nth", "prob", "after"}
+
+
+def test_failover_outcomes_closed_enum():
+    """The failover counter's outcome label values are members of the
+    closed failover.FAILOVER_OUTCOMES tuple at every call site."""
+    from aios_tpu.analysis.core import iter_calls, module_info_for
+    import ast as ast_mod
+
+    from aios_tpu.serving import failover
+
+    mi = module_info_for(failover)
+    outcomes = set()
+    for call in iter_calls(mi.tree):
+        for kw in call.keywords:
+            if kw.arg == "outcome" and isinstance(
+                kw.value, ast_mod.Constant
+            ):
+                outcomes.add(kw.value.value)
+    assert outcomes, "no failover outcome call sites found"
+    assert outcomes <= set(failover.FAILOVER_OUTCOMES)
 
 
 def test_serving_label_conventions():
