@@ -176,6 +176,12 @@ RPC_CHAIN_MARKER = "stub"  # any chain segment containing this matches
 DISPATCH_HYGIENE_MODULES: Tuple[str, ...] = (
     "aios_tpu.engine.engine",
     "aios_tpu.engine.batching",
+    # draft-model speculation (spec.DraftModel): its propose/ingest
+    # bodies are jitted from engine.py behind compile_draft_spec_fn /
+    # compile_draft_ingest_fns, but the module itself is serving-path —
+    # a jax.jit added here must be reachable from a warmup registration
+    # like everything else on the decode hot path
+    "aios_tpu.engine.spec",
 )
 
 # a function whose NAME matches counts as a warmup registration root

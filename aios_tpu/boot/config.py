@@ -57,6 +57,14 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             "prefix_host_bytes": "",
             "host_restore_min_pages": "",
             "speculative": False,    # n-gram speculative decode
+            # draft-model speculation: pair each managed model with a
+            # small draft (preset name or weights path, e.g. "tinyllama")
+            # served int4 — the serving model verifies its proposals in
+            # one dispatch (docs/ENGINE_PERF.md). "" = n-gram only.
+            # spec_reprobe_secs: how long an auto-disabled proposer stays
+            # suspended before probe dispatches re-measure ("" = 10 s).
+            "draft_model": "",
+            "spec_reprobe_secs": "",
             # pipelined decode loop: dispatch N+1 enqueues while dispatch
             # N's tokens are emitted/detokenized (docs/ENGINE_PERF.md);
             # unified_step folds every decode chunk size into ONE
@@ -217,6 +225,8 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         put("AIOS_TPU_MESH", str(m["mesh"]))
     if m.get("speculative"):
         put("AIOS_TPU_SPECULATIVE", "1")
+    if m.get("draft_model"):
+        put("AIOS_TPU_DRAFT_MODEL", str(m["draft_model"]))
     # tri-state decode-loop knobs: "" = unset (config/engine defaults
     # apply); an explicit false forwards too, so config can turn OFF a
     # ModelConfig.decode_pipeline/unified_step default
@@ -251,6 +261,7 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         # an explicit 0 forwards (it means "never auto-disable",
         # overriding a ModelConfig.spec_min_accept default)
         ("spec_min_accept", "AIOS_TPU_SPEC_MIN_ACCEPT", True),
+        ("spec_reprobe_secs", "AIOS_TPU_SPEC_REPROBE_SECS", False),
         # failover_retries = 0 forwards (failover OFF, overriding the
         # serving default of 2)
         ("failover_retries", "AIOS_TPU_FAILOVER_RETRIES", True),

@@ -34,6 +34,8 @@ from .engine import (
     JUMP_BUCKETS, ChunkedPrefill, PendingDecode, TPUEngine, _env_flag,
 )
 from .paged import PoolExhausted
+from .sampling import GREEDY_EPS
+from .spec import SPEC_PROPOSERS
 from .. import faults
 from ..obs import instruments as obs
 from ..obs import flightrec
@@ -55,12 +57,25 @@ _BATCHERS_BY_MODEL: Dict[str, object] = {}
 # (a priority-0 request outranks a fresh strategic (3) after ~15 s).
 PRIORITY_AGING_SECS = 5.0
 
-# How long an EWMA-collapse keeps speculation off before one fresh probe
-# dispatch re-measures (the workload may have turned repetitive again).
+# How long an EWMA-collapse keeps a proposer suspended before probe
+# dispatches re-measure (the workload may have turned repetitive again).
+# Default only — AIOS_TPU_SPEC_REPROBE_SECS / ModelConfig.spec_reprobe_secs
+# / boot [models] spec_reprobe_secs override per deployment.
 SPEC_REPROBE_SECS = 10.0
 
 # EWMA smoothing for the per-dispatch draft-acceptance ratio.
 SPEC_EWMA_ALPHA = 0.3
+
+# Probe dispatches granted after a reprobe window expires: their ratios
+# accumulate into a fresh cumulative average and the floor only re-judges
+# once the budget is consumed — one unlucky probe dispatch (a single
+# non-repetitive request in an otherwise healthy stream) can no longer
+# re-disable speculation instantly on a zeroed EWMA. Deliberately NOT
+# applied to a cold-started batcher: shutting speculation off fast on
+# first evidence is the long-standing (and tested) cold-start behavior,
+# and a wrong first verdict there costs one reprobe window, not a flap
+# cycle.
+SPEC_PROBE_DISPATCHES = 3
 
 # retry-after hint for a retryable crash abort that reached the client
 # (the pool's failover budget was exhausted, or there was no pool)
@@ -205,6 +220,7 @@ class ContinuousBatcher:
         pipeline: Optional[bool] = None,  # depth-2 pipelined decode loop
         jump_ahead: Optional[bool] = None,  # grammar jump-ahead decoding
         spec_min_accept: Optional[float] = None,  # spec auto-disable floor
+        spec_reprobe_secs: Optional[float] = None,  # reprobe window
     ) -> None:
         self.engine = engine
         # Pipelined decode (AIOS_TPU_DECODE_PIPELINE /
@@ -282,8 +298,52 @@ class ContinuousBatcher:
                 getattr(engine.cfg, "spec_min_accept", 0.0)
             )
         self.spec_min_accept = spec_min_accept
-        self.spec_ewma: Optional[float] = None  # None until first measure
-        self._spec_off_until = 0.0
+        # Reprobe window after an auto-disable (AIOS_TPU_SPEC_REPROBE_SECS
+        # / ModelConfig.spec_reprobe_secs): how long a collapsed proposer
+        # stays suspended before its probe dispatches re-measure.
+        if spec_reprobe_secs is None:
+            raw = os.environ.get("AIOS_TPU_SPEC_REPROBE_SECS", "").strip()
+            if raw:
+                try:
+                    spec_reprobe_secs = float(raw)
+                    if spec_reprobe_secs <= 0:
+                        raise ValueError("must be > 0")
+                except ValueError as exc:
+                    log.warning(
+                        "AIOS_TPU_SPEC_REPROBE_SECS=%r ignored (%s)",
+                        raw, exc,
+                    )
+                    spec_reprobe_secs = None
+        if spec_reprobe_secs is None:
+            spec_reprobe_secs = float(
+                getattr(engine.cfg, "spec_reprobe_secs", SPEC_REPROBE_SECS)
+                or SPEC_REPROBE_SECS
+            )
+        self.spec_reprobe_secs = spec_reprobe_secs
+        # Proposer ladder: draft-model speculation when the engine carries
+        # a draft, prompt-lookup n-gram always (the floor of the ladder).
+        # The constrained tick's FSM jump-ahead outranks both — it owns
+        # the tick whenever a constrained slot has a forced run — so the
+        # full preference order is jump-ahead -> draft -> ngram. Each
+        # proposer keeps its OWN acceptance EWMA and suspension window,
+        # so an auto-disable falls one rung (draft -> ngram -> off)
+        # instead of turning speculation off all-or-nothing.
+        self.spec_proposers: Tuple[str, ...] = (
+            ("draft", "ngram") if engine.draft is not None else ("ngram",)
+        )
+        self.spec_ewma: Dict[str, Optional[float]] = {
+            p: None for p in self.spec_proposers
+        }
+        self._spec_off_until: Dict[str, float] = {
+            p: 0.0 for p in self.spec_proposers
+        }
+        # post-reprobe probe budget per proposer (SPEC_PROBE_DISPATCHES)
+        self._spec_probe_left: Dict[str, int] = {
+            p: 0 for p in self.spec_proposers
+        }
+        self._spec_probe_seen: Dict[str, int] = {
+            p: 0 for p in self.spec_proposers
+        }
         self.spec_autodisables = 0
         # Grammar jump-ahead (AIOS_TPU_JUMP_AHEAD /
         # ModelConfig.jump_ahead, default ON): chains of grammar-FORCED
@@ -360,6 +420,12 @@ class ContinuousBatcher:
                     engine.compile_spec_fn(
                         n, self.spec_draft_len, self.spec_ngram
                     )
+                    # the draft proposer's fused graphs for the same round
+                    # sizes (no-ops without a draft model), so the ladder
+                    # never compiles mid-serving whichever rung serves
+                    engine.compile_draft_spec_fn(n, self.spec_draft_len)
+                if engine.draft is not None:
+                    engine.compile_draft_ingest_fns()
             if self.jump_ahead and "masked" in engine._step_fns:
                 # constrained serving was declared at warmup (the masked
                 # graph is the same signal json-mode deployments use):
@@ -401,15 +467,20 @@ class ContinuousBatcher:
             lambda: float(sum(1 for b in peers if b._pending is not None))
         )
 
-        def _acceptance() -> float:
-            vals = [
-                b.spec_ewma for b in peers if b.spec_ewma is not None
-            ]
-            return float(sum(vals) / len(vals)) if vals else 0.0
+        def _acceptance(proposer):
+            def read() -> float:
+                vals = [
+                    b.spec_ewma.get(proposer) for b in peers
+                    if b.spec_ewma.get(proposer) is not None
+                ]
+                return float(sum(vals) / len(vals)) if vals else 0.0
 
-        obs.SPEC_ACCEPTANCE.labels(model=model_name).set_function(
-            _acceptance
-        )
+            return read
+
+        for p in SPEC_PROPOSERS:
+            obs.SPEC_ACCEPTANCE.labels(
+                model=model_name, proposer=p
+            ).set_function(_acceptance(p))
         # tokens/sec gauge state: emitted tokens over a ~1 s window,
         # refreshed from the scheduler loop (decays to 0 when idle).
         # last_tps additionally keeps the most recent NON-ZERO rate so the
@@ -1177,52 +1248,100 @@ class ContinuousBatcher:
             except Exception as exc:  # noqa: BLE001
                 self._abort_all(exc)
 
-    # -- speculative auto-disable (EWMA acceptance floor) -------------------
+    # -- speculative auto-disable (per-proposer EWMA acceptance floor) ------
+
+    def _spec_proposer(self, greedy_live: bool = True) -> Optional[str]:
+        """Which proposer the next decode tick should dispatch with, or
+        None when every rung of the ladder is suspended. Each proposer
+        keeps its own EWMA and suspension window, so a collapsed draft
+        model falls back to n-gram (not to nothing) and a collapsed
+        n-gram still leaves the draft serving. An expired window grants
+        the proposer SPEC_PROBE_DISPATCHES probe dispatches on a fresh
+        cumulative average before the floor re-judges (a zeroed EWMA let
+        one bad probe re-disable instantly). ``greedy_live=False`` skips
+        the draft rung: with no greedy slot live the draft's K propose
+        steps are pure overhead AND produce no measurable acceptance, so
+        the tick falls through to n-gram, whose zero-acceptance EWMA
+        suspends speculation properly."""
+        now = time.monotonic()
+        for p in self.spec_proposers:
+            if p == "draft" and not greedy_live:
+                continue
+            off = self._spec_off_until[p]
+            if off:
+                if now < off:
+                    continue
+                self._spec_off_until[p] = 0.0
+                self.spec_ewma[p] = None
+                self._spec_probe_left[p] = SPEC_PROBE_DISPATCHES
+                self._spec_probe_seen[p] = 0
+            return p
+        return None
 
     def _spec_active(self) -> bool:
-        """Whether the next decode tick may dispatch speculatively. An
-        EWMA-collapse below ``spec_min_accept`` suspends speculation for
-        SPEC_REPROBE_SECS (plain/pipelined decode serves meanwhile — the
-        failed drafts were pure per-dispatch overhead); when the window
-        expires the EWMA resets so ONE probe dispatch re-decides on fresh
-        evidence instead of dragging the collapsed history along."""
-        if not self._spec_off_until:
-            return True
-        if time.monotonic() < self._spec_off_until:
-            return False
-        self._spec_off_until = 0.0
-        self.spec_ewma = None  # re-probe: fresh measurement decides
-        return True
+        """Whether the next decode tick may dispatch speculatively at
+        all (any rung of the proposer ladder available)."""
+        return self._spec_proposer() is not None
 
-    def _spec_measure(self, counts, consumed: Dict[int, int]) -> None:
-        """Fold one spec dispatch's acceptance into the EWMA and suspend
-        speculation when it collapses below the floor. ``counts`` is the
-        dispatch's [rounds, num_slots] emitted-token matrix; ``consumed``
-        maps slot -> rounds whose tokens were actually EMITTED (each
-        emits 1 + accepted-drafts). Rounds past a request's mid-dispatch
-        retirement are excluded — their drafts score a continuation that
-        is never served, and folding them in would suspend speculation on
-        workloads whose served tokens accept perfectly well."""
-        possible = sum(consumed.values()) * self.spec_draft_len
+    def _spec_measure(self, proposer: str, counts,
+                      consumed: Dict[int, int], proposed=None) -> None:
+        """Fold one spec dispatch's acceptance into ``proposer``'s EWMA
+        and suspend THAT proposer when it collapses below the floor.
+        ``counts`` is the dispatch's [rounds, num_slots] emitted-token
+        matrix; ``consumed`` maps slot -> rounds whose tokens were
+        actually EMITTED (each emits 1 + accepted-drafts). Rounds past a
+        request's mid-dispatch retirement are excluded — their drafts
+        score a continuation that is never served, and folding them in
+        would suspend speculation on workloads whose served tokens
+        accept perfectly well. ``proposed`` (draft proposer) is the
+        [rounds, num_slots] offered-token matrix: the denominator counts
+        only real proposals, so sampled-heavy batches don't read as
+        rejection — the n-gram proposer keeps its historical
+        every-round denominator."""
+        if proposed is None:
+            possible = sum(consumed.values()) * self.spec_draft_len
+        else:
+            possible = sum(
+                float(proposed[:r, s].sum()) for s, r in consumed.items()
+            )
         if not possible:
             return
         accepted = sum(
             float(counts[:r, s].sum()) - r for s, r in consumed.items()
         )
         ratio = max(accepted, 0.0) / possible
-        self.spec_ewma = (
-            ratio if self.spec_ewma is None
-            else (1 - SPEC_EWMA_ALPHA) * self.spec_ewma
-            + SPEC_EWMA_ALPHA * ratio
-        )
-        if self.spec_min_accept > 0 and self.spec_ewma < self.spec_min_accept:
-            self._spec_off_until = time.monotonic() + SPEC_REPROBE_SECS
+        prev = self.spec_ewma[proposer]
+        if prev is None:
+            self.spec_ewma[proposer] = ratio
+            self._spec_probe_seen[proposer] = 1
+        elif self._spec_probe_left[proposer] > 0:
+            # probe phase: cumulative average over the probe budget (an
+            # EWMA seeded from one sample would weight it like a whole
+            # collapsed history)
+            n = self._spec_probe_seen[proposer]
+            self.spec_ewma[proposer] = (prev * n + ratio) / (n + 1)
+            self._spec_probe_seen[proposer] = n + 1
+        else:
+            self.spec_ewma[proposer] = (
+                (1 - SPEC_EWMA_ALPHA) * prev + SPEC_EWMA_ALPHA * ratio
+            )
+        if self._spec_probe_left[proposer] > 0:
+            self._spec_probe_left[proposer] -= 1
+            if self._spec_probe_left[proposer] > 0:
+                return  # verdict deferred until the probe budget drains
+        if (
+            self.spec_min_accept > 0
+            and self.spec_ewma[proposer] < self.spec_min_accept
+        ):
+            self._spec_off_until[proposer] = (
+                time.monotonic() + self.spec_reprobe_secs
+            )
             self.spec_autodisables += 1
             log.info(
-                "%s: speculation suspended (EWMA acceptance %.3f < "
+                "%s: %s speculation suspended (EWMA acceptance %.3f < "
                 "floor %.3f); re-probing in %.0fs",
-                self.engine.cfg.name, self.spec_ewma,
-                self.spec_min_accept, SPEC_REPROBE_SECS,
+                self.engine.cfg.name, proposer, self.spec_ewma[proposer],
+                self.spec_min_accept, self.spec_reprobe_secs,
             )
 
     # -- grammar jump-ahead (compressed-FSM run collapse) -------------------
@@ -1410,19 +1529,34 @@ class ContinuousBatcher:
         with self._qlock:
             anyone_waiting = bool(self._waiting) or self._prefilling is not None
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
-        if self.speculative and self._spec_active():
+        proposer = None
+        if self.speculative:
+            # the draft rung needs a greedy slot to propose for; without
+            # one it falls through to n-gram (see _spec_proposer)
+            greedy_live = any(
+                l.req.temperature < GREEDY_EPS for l in slots.values()
+            )
+            proposer = self._spec_proposer(greedy_live)
+        if proposer is not None:
             # [n, S, K+1] tokens, [n, S] counts — emit each round's accepted
             # run in order; _emit retires requests mid-dispatch as usual.
             # Speculative dispatches consume their own output synchronously
             # (acceptance counts gate the emit), so they never pipeline;
             # drain any pending plain dispatch first.
             self._flush_pending("spec")
+            proposed = None
             try:
                 gap = self._note_dispatch()
                 t0 = time.monotonic()
-                tokens, counts = self.engine.spec_step(
-                    n, draft_len=self.spec_draft_len, ngram=self.spec_ngram
-                )
+                if proposer == "draft":
+                    tokens, counts, proposed = self.engine.spec_step_draft(
+                        n, draft_len=self.spec_draft_len
+                    )
+                else:
+                    tokens, counts = self.engine.spec_step(
+                        n, draft_len=self.spec_draft_len,
+                        ngram=self.spec_ngram,
+                    )
                 self._gap_mark = time.monotonic()
             except PoolExhausted as e:
                 self._evict_longest(e.replica)  # retry next tick
@@ -1445,13 +1579,13 @@ class ContinuousBatcher:
                     # emitted = rounds + accepted drafts for this slot's
                     # SERVED rounds (the _spec_measure accounting)
                     rec.event(
-                        "spec", rounds=rounds,
+                        "spec", rounds=rounds, proposer=proposer,
                         emitted=int(counts[:rounds, slot].sum()),
                         draft_len=self.spec_draft_len, dur_ms=dur_ms,
                         **({"gap_ms": round(gap * 1e3, 3)}
                            if gap is not None else {}),
                     )
-            self._spec_measure(counts, consumed)
+            self._spec_measure(proposer, counts, consumed, proposed)
             return
         if self.pipeline:
             # depth-2 double buffer: hand dispatch N+1 to the engine's

@@ -62,11 +62,25 @@ class ModelConfig:
     # each. Greedy-identical to the per-step path; AIOS_TPU_JUMP_AHEAD
     # overrides at load time (docs/ENGINE_PERF.md).
     jump_ahead: bool = True
-    # auto-disable n-gram speculation per batcher when the EWMA draft-
-    # acceptance ratio collapses below this floor (plain/pipelined decode
-    # serves meanwhile; one probe dispatch re-measures periodically).
+    # auto-disable speculation per batcher and PER PROPOSER when that
+    # proposer's EWMA draft-acceptance ratio collapses below this floor
+    # (the ladder falls draft -> ngram -> off; plain/pipelined decode
+    # serves meanwhile and probe dispatches re-measure periodically).
     # 0 = never auto-disable. AIOS_TPU_SPEC_MIN_ACCEPT overrides.
     spec_min_accept: float = 0.0
+    # how long an auto-disabled proposer stays suspended before its probe
+    # dispatches re-measure (engine/batching.py SPEC_PROBE_DISPATCHES of
+    # them re-judge on a fresh cumulative average).
+    # AIOS_TPU_SPEC_REPROBE_SECS overrides at load time.
+    spec_reprobe_secs: float = 10.0
+    # draft-model speculation (engine/spec.py DraftModel): the model
+    # source — a preset name like "tinyllama" or a weights path — loaded
+    # as an int4 draft whose proposals the serving model verifies in one
+    # dispatch (docs/ENGINE_PERF.md). "" = n-gram prompt-lookup only.
+    # Requires the serving and draft models to share a tokenizer/vocab;
+    # single-device pools only (dp-replicated pools fall back to n-gram).
+    # AIOS_TPU_DRAFT_MODEL overrides at load time.
+    draft_model: str = ""
     # radix-tree prefix index (engine/paged.py RadixPrefixIndex): cross-
     # request prefix sharing by construction with leaf-LRU eviction and
     # partial-node overlap credit for the router. False = the legacy flat

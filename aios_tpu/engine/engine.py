@@ -56,6 +56,15 @@ DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 JUMP_BUCKETS = (4, 16)
 assert JUMP_BUCKETS[-1] <= spec.HISTORY_PAD - 2
 
+# Width buckets for the standalone draft-KV bulk-ingest graphs: a freshly
+# admitted (or failed-over) slot's draft cache trails the serving state by
+# the whole prompt, and spec_step_draft catches it up in these power-of-
+# two teacher-forced chunks before the fused rounds take over (whose
+# per-round catch-up width is only draft_len+1 — the steady-state gap is
+# 0 or 1). Capped at the shared prefill-chunk granularity; the draft tier
+# is small, so each graph is a cheap compile.
+DRAFT_INGEST_BUCKETS = (32, 64, 128, 256, 512)
+
 # Live HostPageStores per model name: replica engines share the (model,)
 # label on the aios_tpu_prefix_host_* gauges, so the scrape callbacks sum
 # over this set instead of reporting whichever replica registered last.
@@ -288,6 +297,7 @@ class TPUEngine:
         track_history: bool = True,  # device-side token history (spec.py)
         unified_step: Optional[bool] = None,  # one dynamic-n decode graph
         prefix_radix: Optional[bool] = None,  # radix-tree prefix index
+        draft: Optional["spec.DraftModel"] = None,  # draft-model proposer
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -656,6 +666,60 @@ class TPUEngine:
             self.state["k_s"] = k_s
             self.state["v_s"] = v_s
 
+        # Draft-model speculation (spec.DraftModel): the small tier
+        # proposes, the serving model verifies — single-device only (the
+        # draft cache and its graphs have no shard_map twins), on top of
+        # the same verify machinery/track-history requirements as n-gram
+        # speculation. A config that can't carry it FALLS BACK to n-gram
+        # (the batcher's proposer ladder) rather than failing the load;
+        # a vocab mismatch is a hard error — draft tokens feed the
+        # serving verify directly, so it could never produce sense.
+        self.draft: Optional[spec.DraftModel] = None
+        self.draft_state = None
+        self._draft_host_lengths = np.zeros(num_slots, dtype=np.int64)
+        # host mirror of "slot decodes greedily" (set at admission):
+        # only greedy slots ever propose, so the bulk-ingest gap math
+        # skips sampling slots instead of building draft KV their ok
+        # gate guarantees is never read
+        self._host_greedy = np.zeros(num_slots, dtype=bool)
+        self._draft_fns: Dict[object, object] = {}
+        if draft is not None:
+            if draft.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft model vocab ({draft.cfg.vocab_size}) must "
+                    f"match the serving model's ({cfg.vocab_size}) — they "
+                    "must share one tokenizer"
+                )
+            if shardings is not None:
+                log.warning(
+                    "%s: draft-model speculation is single-device only; "
+                    "falling back to the n-gram proposer under a sharding "
+                    "plan", cfg.name,
+                )
+            elif not self.spec_supported:
+                log.warning(
+                    "%s: draft-model speculation unsupported on a "
+                    "dp-replicated page pool; falling back to the n-gram "
+                    "proposer", cfg.name,
+                )
+            elif not self.track_history:
+                log.warning(
+                    "%s: draft-model speculation needs the token history "
+                    "(track_history=True); draft model ignored", cfg.name,
+                )
+            else:
+                self.draft = draft
+                # draft cache rows mirror history columns 1:1, so it is
+                # sized to the SERVING context; bf16 stands in when the
+                # serving cache is int8 (the draft path has no scales)
+                self.draft_state = draft.init_state(
+                    num_slots, self.max_context,
+                    cache_dtype=(
+                        cache_dtype if cache_dtype != jnp.int8
+                        else jnp.bfloat16
+                    ),
+                )
+
         # host-side mirror for the scheduler
         self.active = np.zeros(num_slots, dtype=bool)
         self._host_lengths = np.zeros(num_slots, dtype=np.int64)
@@ -743,6 +807,15 @@ class TPUEngine:
         self.spec_rounds = 0
         self.spec_tokens = 0
         self.spec_slot_rounds = 0
+        # per-proposer splits of the speculative counters (the
+        # aios_tpu_spec_*{proposer=...} label): rounds dispatched and
+        # draft tokens accepted, keyed by spec.SPEC_PROPOSERS
+        self.spec_proposer_rounds = {p: 0 for p in spec.SPEC_PROPOSERS}
+        self.spec_proposer_accepted = {p: 0 for p in spec.SPEC_PROPOSERS}
+        # draft-side dispatch accounting: bulk ingest dispatches (the
+        # catch-up KV writes outside the fused round) and tokens proposed
+        self.draft_ingest_dispatches = 0
+        self.draft_proposed_tokens = 0
         # grammar jump-ahead accounting (jump_step): dispatches and the
         # forced tokens they appended — each dispatch replaced
         # jump_tokens/jump_dispatches masked single-token dispatches
@@ -801,16 +874,24 @@ class TPUEngine:
         obs.ENGINE_JUMP_TOKENS.labels(model=name).set_function(
             engines_sum("jump_tokens")
         )
-        obs.SPEC_ROUNDS.labels(model=name).set_function(
-            engines_sum("spec_rounds")
-        )
-        obs.SPEC_ACCEPTED.labels(model=name).set_function(
-            # accepted DRAFT tokens: emitted minus the guaranteed one
-            # free token per (slot, round)
-            lambda: float(sum(
-                max(e.spec_tokens - e.spec_slot_rounds, 0) for e in engines
-            ))
-        )
+        # spec counters carry the (model, proposer) label pair — one
+        # series per proposer in the closed spec.SPEC_PROPOSERS enum,
+        # each summing its per-proposer engine counter over the WeakSet
+        def proposer_sum(attr, proposer):
+            def read() -> float:
+                return float(sum(
+                    getattr(e, attr).get(proposer, 0) for e in engines
+                ))
+
+            return read
+
+        for p in spec.SPEC_PROPOSERS:
+            obs.SPEC_ROUNDS.labels(model=name, proposer=p).set_function(
+                proposer_sum("spec_proposer_rounds", p)
+            )
+            obs.SPEC_ACCEPTED.labels(model=name, proposer=p).set_function(
+                proposer_sum("spec_proposer_accepted", p)
+            )
         if self.allocator is not None:
             def pages_in_use() -> float:
                 e = ref()
@@ -1018,6 +1099,48 @@ class TPUEngine:
         )
         return state, tokens  # tokens [max_steps, S]; rows [n:] are zeros
 
+    def _verify_moe_impl(self, feed_width: int):
+        """The gathered-MoE crossover gate shared by every verify-shaped
+        dispatch (spec rounds, jump-ahead, draft verify): feeding W
+        tokens per slot shifts the gather-vs-dense traffic crossover by
+        that factor — gathering S*W*k expert blocks (with duplicates
+        re-streamed) must still undercut the dense path's X blocks, or
+        the verify falls back to dense."""
+        if (
+            self._moe_impl == "gather"
+            and self.num_slots * feed_width * self.cfg.num_experts_per_tok
+            >= self.cfg.num_experts
+        ):
+            return None
+        return self._moe_impl
+
+    def _verify_feed(self, params, st: DecodeState, feed, tables=None):
+        """One multi-token verify forward against whichever cache layout
+        this engine runs — the shared dispatch body of ``_spec_impl``,
+        ``_jump_impl`` and ``_draft_spec_impl``. ``feed`` is [S, W]
+        ([last_token, draft/forced tokens...]); returns
+        (logits [S, W, V], k, v, scales-or-None)."""
+        scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
+        moe_impl = self._verify_moe_impl(feed.shape[1])
+        if self.paged:
+            out = model.verify_step_paged(
+                params, self.cfg, feed, st["lengths"], st["k"], st["v"],
+                tables, cache_scales=scales, active=st["active"],
+                moe_impl=moe_impl, qmm=self._qmm_gspmd,
+            )
+        else:
+            out = model.verify_step(
+                params, self.cfg, feed, st["lengths"], st["k"], st["v"],
+                kernels=self._kernels, cache_scales=scales,
+                active=st["active"], moe_impl=moe_impl,
+                qmm=self._qmm_gspmd,
+            )
+        if self.quant_cache:
+            logits, k, v, (k_s, v_s) = out
+            return logits, k, v, (k_s, v_s)
+        logits, k, v = out
+        return logits, k, v, None
+
     def _spec_impl(
         self, params, state: DecodeState, n_rounds: int, draft_len: int,
         ngram: int, tables=None,
@@ -1030,17 +1153,6 @@ class TPUEngine:
         so this is a strict generalization of ``_step_impl``."""
         S, C, K = self.num_slots, self.max_context, draft_len
         slots = jnp.arange(S)
-        # verify feeds K+1 tokens per slot, so the gather-vs-dense traffic
-        # crossover shifts by that factor: gathering S*(K+1)*k expert
-        # blocks (with duplicates re-streamed) must still undercut the
-        # dense path's X blocks, or verify falls back to dense
-        verify_moe_impl = self._moe_impl
-        if (
-            self._moe_impl == "gather"
-            and S * (K + 1) * self.cfg.num_experts_per_tok
-            >= self.cfg.num_experts
-        ):
-            verify_moe_impl = None
 
         def one(st, _):
             drafts, _num = spec.propose_ngram(
@@ -1053,46 +1165,11 @@ class TPUEngine:
             feed = jnp.concatenate(
                 [st["last_tokens"][:, None], drafts], axis=1
             )  # [S, K+1]
-            if self.paged:
-                scales = (
-                    (st["k_s"], st["v_s"]) if self.quant_cache else None
-                )
-                out = model.verify_step_paged(
-                    params,
-                    self.cfg,
-                    feed,
-                    st["lengths"],
-                    st["k"],
-                    st["v"],
-                    tables,
-                    cache_scales=scales,
-                    active=st["active"],
-                    moe_impl=verify_moe_impl,
-                    qmm=self._qmm_gspmd,
-                )
-                if self.quant_cache:
-                    logits, k, v, (k_s, v_s) = out
-                else:
-                    logits, k, v = out
-            else:
-                scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
-                out = model.verify_step(
-                    params,
-                    self.cfg,
-                    feed,
-                    st["lengths"],
-                    st["k"],
-                    st["v"],
-                    kernels=self._kernels,
-                    cache_scales=scales,
-                    active=st["active"],
-                    moe_impl=verify_moe_impl,
-                    qmm=self._qmm_gspmd,
-                )
-                if self.quant_cache:
-                    logits, k, v, (k_s, v_s) = out
-                else:
-                    logits, k, v = out
+            logits, k, v, new_scales = self._verify_feed(
+                params, st, feed, tables
+            )
+            if self.quant_cache:
+                k_s, v_s = new_scales
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
             a = spec.accept_counts(drafts, g)  # [S] in [0, K]
             key, sub = jax.random.split(st["key"])
@@ -1133,6 +1210,178 @@ class TPUEngine:
         state, (tokens, counts) = jax.lax.scan(one, state, None, length=n_rounds)
         return state, (tokens, counts)  # [R, S, K+1], [R, S]
 
+    # -- draft-model speculation (spec.DraftModel) --------------------------
+    # The draft keeps its own dense KV cache whose rows [0, d_len) mirror
+    # history[:, 0:d_len) — the same contract the serving cache keeps with
+    # its lengths — so keeping it consistent across accept/reject/retire
+    # is a matter of moving d_len, never of rewriting rows: accepted draft
+    # rows were written by the draft itself, rejected rows fall beyond the
+    # clamped d_len and are overwritten before they can be read.
+
+    def _draft_ingest_body(self, dparams, dstate, history, t_lengths,
+                           active, width: int):
+        """Teacher-forced draft catch-up: ingest up to ``width`` history
+        tokens per slot into the draft KV (rows [d_len, d_len+width)),
+        advancing draft lengths toward the serving model's. Write-only —
+        the draft's logits are discarded; this is a verify forward used
+        as a bulk KV writer. Slots already caught up (or inactive) gate
+        out via ``active``, so their writes land on the sacrificial row."""
+        dcfg = self.draft.cfg
+        d_len = dstate["lengths"]
+        gap = jnp.maximum(t_lengths - d_len, 0)
+        ing = active & (gap > 0)
+        # [S, width] gather from the history buffer; small next to the
+        # draft forward it feeds (not the [S, W] full-width gather class
+        # propose_ngram avoids — width here is bounded by the ingest
+        # bucket, not the context)
+        idx = jnp.clip(
+            d_len[:, None] + jnp.arange(width)[None, :],
+            0, history.shape[1] - 1,
+        )
+        feed = jnp.take_along_axis(history, idx, axis=1)
+        _logits, k, v = model.verify_step(
+            dparams, dcfg, feed, d_len, dstate["k"], dstate["v"],
+            kernels=self._kernels, active=ing,
+        )
+        new_len = d_len + jnp.where(ing, jnp.minimum(gap, width), 0)
+        return {"k": k, "v": v, "lengths": new_len}
+
+    def _draft_ingest_impl(self, dparams, dstate, history, t_lengths,
+                           active, temps, width: int):
+        """The standalone bulk-ingest graph (power-of-two ``width``
+        buckets): freshly admitted slots' draft KV trails by the whole
+        prompt, and burning fused-round catch-up budget on it would cost
+        one round per CATCHUP-width chunk. Sampling slots never propose,
+        so only greedy slots ingest. Serving state is read-only here;
+        only the draft state is donated."""
+        return self._draft_ingest_body(
+            dparams, dstate, history, t_lengths,
+            active & (temps < sampling.GREEDY_EPS), width,
+        )
+
+    def _draft_propose_body(self, dparams, dstate, t_last, ok, draft_len):
+        """K autoregressive greedy draft steps: step 1 consumes the
+        serving model's pending token (writing its draft-KV row at
+        d_len), later steps consume the draft's own argmax. Non-proposing
+        slots still run (fixed-shape graph) but write the sacrificial row
+        and never advance. Returns (drafts [S, K] with -1 rows for
+        non-proposing slots, new draft state)."""
+        dcfg = self.draft.cfg
+        C = dstate["k"].shape[2]
+
+        def one(carry, _):
+            k, v, cur_len, cur_tok = carry
+            logits, k, v = model.decode_step(
+                dparams, dcfg, cur_tok, cur_len, k, v,
+                kernels=self._kernels, active=ok,
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_len = jnp.where(ok, jnp.minimum(cur_len + 1, C - 1), cur_len)
+            return (k, v, new_len, nxt), nxt
+
+        (k, v, d_len, _), drafts = jax.lax.scan(
+            one,
+            (dstate["k"], dstate["v"], dstate["lengths"], t_last),
+            None, length=draft_len,
+        )
+        drafts = jnp.where(ok[:, None], drafts.T, -1)  # [S, K]
+        return drafts, {"k": k, "v": v, "lengths": d_len}
+
+    def _draft_spec_impl(
+        self, params, dparams, state: DecodeState, dstate, n_rounds: int,
+        draft_len: int, catchup: int, tables=None,
+    ):
+        """R draft-model speculative rounds in ONE dispatch: each round
+        catches the draft KV up to the serving state (teacher-forced,
+        width ``catchup`` — steady-state gap is 0 or 1), runs K
+        autoregressive draft steps, verifies the whole draft through the
+        serving model's verify forward, accepts the longest matching
+        prefix (exact for greedy slots — token streams identical to plain
+        decode), and clamps the draft lengths back to the verified
+        length so rejected draft rows become unreadable. Sampling and
+        inactive slots degrade to one plain decode step per round,
+        exactly like ``_spec_impl``; slots whose draft is still catching
+        up (gap > catchup) also take the plain step this round and
+        propose next round. Returns (state', dstate',
+        (tokens [R, S, K+1], counts [R, S], proposed [R, S]))."""
+        S, C, K = self.num_slots, self.max_context, draft_len
+        slots = jnp.arange(S)
+
+        def one(carry, _):
+            st, dst = carry
+            # sampling slots never propose (the ok gate below), so
+            # building their draft KV would be pure ingest cost — gate
+            # the catch-up on greedy too
+            greedy_active = st["active"] & (
+                st["temps"] < sampling.GREEDY_EPS
+            )
+            dst = self._draft_ingest_body(
+                dparams, dst, st["history"], st["lengths"], greedy_active,
+                catchup,
+            )
+            # propose only where the draft mirrors the serving cache
+            # exactly AND the verify-write contract has room for a full
+            # K-draft acceptance (accepted rows stay <= C-2)
+            ok = (
+                (st["temps"] < sampling.GREEDY_EPS)
+                & st["active"]
+                & (dst["lengths"] == st["lengths"])
+                & (st["lengths"] + K <= C - 2)
+            )
+            drafts, dst = self._draft_propose_body(
+                dparams, dst, st["last_tokens"], ok, K
+            )
+            proposed = jnp.where(ok, K, 0)
+            feed = jnp.concatenate(
+                [st["last_tokens"][:, None], drafts], axis=1
+            )  # [S, K+1]
+            logits, k, v, new_scales = self._verify_feed(
+                params, st, feed, tables
+            )
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+            a = spec.accept_counts(drafts, g)  # [S] in [0, K]
+            key, sub = jax.random.split(st["key"])
+            first = sampling.sample(
+                logits[:, 0], sub, st["temps"], st["top_ps"]
+            )
+            out_tokens = g.at[:, 0].set(first)  # [S, K+1]
+            counts = a + 1
+            new_last = jnp.take_along_axis(
+                out_tokens, a[:, None], axis=1
+            )[:, 0]
+            hidx = jnp.where(
+                st["active"][:, None],
+                st["lengths"][:, None] + 1 + jnp.arange(K + 1)[None, :],
+                st["history"].shape[1] - 1,
+            )
+            new_lengths = jnp.minimum(st["lengths"] + counts, C - 1)
+            st = {
+                "k": k,
+                "v": v,
+                "lengths": new_lengths,
+                "last_tokens": new_last,
+                "temps": st["temps"],
+                "top_ps": st["top_ps"],
+                "active": st["active"],
+                "history": st["history"].at[
+                    slots[:, None], hidx
+                ].set(out_tokens),
+                "key": key,
+            }
+            if self.quant_cache:
+                st["k_s"], st["v_s"] = new_scales
+            # draft sync: rows for accepted tokens are already correct
+            # (the draft wrote them while proposing); everything past the
+            # verified length — rejected drafts, or the bonus token's
+            # still-unwritten row after a full accept — is clamped out
+            dst = dict(dst, lengths=jnp.minimum(dst["lengths"], new_lengths))
+            return (st, dst), (out_tokens, counts, proposed)
+
+        (state, dstate), (tokens, counts, proposed) = jax.lax.scan(
+            one, (state, dstate), None, length=n_rounds
+        )
+        return state, dstate, (tokens, counts, proposed)
+
     def _jump_impl(self, params, state: DecodeState, forced, counts,
                    tables=None):
         """Grammar jump-ahead: append a host-computed FORCED token run to
@@ -1156,33 +1405,11 @@ class TPUEngine:
         S, C, K = self.num_slots, self.max_context, forced.shape[1]
         slots = jnp.arange(S)
         st = state
-        # same gathered-MoE crossover gate as _spec_impl's verify
-        verify_moe_impl = self._moe_impl
-        if (
-            self._moe_impl == "gather"
-            and S * (K + 1) * self.cfg.num_experts_per_tok
-            >= self.cfg.num_experts
-        ):
-            verify_moe_impl = None
         feed = jnp.concatenate([st["last_tokens"][:, None], forced], axis=1)
-        scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
-        if self.paged:
-            out = model.verify_step_paged(
-                params, self.cfg, feed, st["lengths"], st["k"], st["v"],
-                tables, cache_scales=scales, active=st["active"],
-                moe_impl=verify_moe_impl, qmm=self._qmm_gspmd,
-            )
-        else:
-            out = model.verify_step(
-                params, self.cfg, feed, st["lengths"], st["k"], st["v"],
-                kernels=self._kernels, cache_scales=scales,
-                active=st["active"], moe_impl=verify_moe_impl,
-                qmm=self._qmm_gspmd,
-            )
+        _logits, k, v, new_scales = self._verify_feed(params, st, feed,
+                                                      tables)
         if self.quant_cache:
-            _logits, k, v, (k_s, v_s) = out
-        else:
-            _logits, k, v = out
+            k_s, v_s = new_scales
         jumped = counts > 0
         new_last = jnp.where(
             jumped,
@@ -1505,6 +1732,27 @@ class TPUEngine:
             donate_argnums=(1,),
         )
 
+    def _make_draft_spec_jit(self, key: Tuple[int, int, int]):
+        if self.paged:
+            return jax.jit(
+                lambda p, dp, s, ds, t: self._draft_spec_impl(
+                    p, dp, s, ds, *key, tables=t
+                ),
+                donate_argnums=(2, 3),
+            )
+        return jax.jit(
+            lambda p, dp, s, ds: self._draft_spec_impl(p, dp, s, ds, *key),
+            donate_argnums=(2, 3),
+        )
+
+    def _make_draft_ingest_jit(self, width: int):
+        return jax.jit(
+            lambda dp, ds, h, tl, act, tm: self._draft_ingest_impl(
+                dp, ds, h, tl, act, tm, width
+            ),
+            donate_argnums=(1,),
+        )
+
     def _make_prefill_jit(self):
         impl = self._prefill_impl_paged if self.paged else self._prefill_impl
         return jax.jit(impl, donate_argnums=(1,))
@@ -1595,6 +1843,45 @@ class TPUEngine:
             "spec", self._spec_fns, key, self._make_spec_jit(key),
             self._step_example(),
         )
+
+    def compile_draft_spec_fn(self, n_rounds: int, draft_len: int) -> None:
+        """Ensure the fused draft-propose + verify graph for
+        ``n_rounds`` rounds exists WITHOUT dispatching (warmup and the
+        batcher attach call this for the batcher's actual dispatch
+        sizes, keeping the flat-compile-counters invariant). No-op when
+        no draft model is attached."""
+        if self.draft is None:
+            return
+        key = (n_rounds, draft_len, draft_len + 1)
+        if key in self._draft_fns:
+            return
+        self._compile_aot(
+            "draft_spec", self._draft_fns, key,
+            self._make_draft_spec_jit(key),
+            (self.params, self.draft.params, self.state, self.draft_state)
+            + ((jnp.asarray(self.allocator.tables),) if self.paged else ()),
+        )
+
+    def compile_draft_ingest_fns(self) -> None:
+        """Ensure every bulk draft-ingest bucket graph exists WITHOUT
+        dispatching; no-op without a draft model."""
+        if self.draft is None:
+            return
+        for w in self._draft_ingest_buckets():
+            key = ("ingest", w)
+            if key in self._draft_fns:
+                continue
+            self._compile_aot(
+                "draft_ingest", self._draft_fns, key,
+                self._make_draft_ingest_jit(w),
+                (self.draft.params, self.draft_state,
+                 self.state["history"], self.state["lengths"],
+                 self.state["active"], self.state["temps"]),
+            )
+
+    def _draft_ingest_buckets(self) -> Tuple[int, ...]:
+        bs = tuple(b for b in DRAFT_INGEST_BUCKETS if b <= self.max_context)
+        return bs or DRAFT_INGEST_BUCKETS[:1]
 
     def compile_jump_fn(self, k_bucket: int) -> None:
         """Ensure the ``k_bucket``-run jump-ahead graph exists WITHOUT
@@ -1734,6 +2021,26 @@ class TPUEngine:
         if fn is None:
             fn = self._instrument_compile(self._make_spec_jit(key), "spec")
             self._spec_fns[key] = fn
+        return fn
+
+    def _draft_spec_fn(self, n_rounds: int, draft_len: int):
+        key = (n_rounds, draft_len, draft_len + 1)
+        fn = self._draft_fns.get(key)
+        if fn is None:
+            fn = self._instrument_compile(
+                self._make_draft_spec_jit(key), "draft_spec"
+            )
+            self._draft_fns[key] = fn
+        return fn
+
+    def _draft_ingest_fn(self, width: int):
+        key = ("ingest", width)
+        fn = self._draft_fns.get(key)
+        if fn is None:
+            fn = self._instrument_compile(
+                self._make_draft_ingest_jit(width), "draft_ingest"
+            )
+            self._draft_fns[key] = fn
         return fn
 
     def _jump_fn(self, k_bucket: int):
@@ -2204,6 +2511,7 @@ class TPUEngine:
                 args.append(jnp.asarray(self.allocator.tables[slot]))
             self.state, first = self._prefill_fn(bucket)(*args)
             self.active[slot] = True
+            self._host_greedy[slot] = temperature < sampling.GREEDY_EPS
             self._host_lengths[slot] = true_len
             self._register_prefix(slot, token_ids, hashes)
             return int(first)
@@ -2455,9 +2763,11 @@ class TPUEngine:
             self.decode_steps += n_rounds
             self._obs_decode_steps.inc(n_rounds)
             self.spec_rounds += n_rounds
+            self.spec_proposer_rounds["ngram"] += n_rounds
             # acceptance denominator: (round, active-slot) pairs — a
             # per-slot rate that doesn't scale with batch occupancy
-            self.spec_slot_rounds += n_rounds * int(self.active.sum())
+            active_rounds = n_rounds * int(self.active.sum())
+            self.spec_slot_rounds += active_rounds
         # the device->host readback happens OUTSIDE the engine lock
         # (the step()/step_masked() discipline, lock-readback rule):
         # concurrent peek/stats callers must not wait on the transfer
@@ -2468,20 +2778,125 @@ class TPUEngine:
         # the pipeline first), so nothing interleaves between the two
         # critical sections
         with self._lock:
-            self.spec_tokens += int(counts[:, self.active].sum())
+            emitted = int(counts[:, self.active].sum())
+            self.spec_tokens += emitted
+            self.spec_proposer_accepted["ngram"] += max(
+                emitted - active_rounds, 0
+            )
             self._host_lengths = np.minimum(
                 self._host_lengths + counts.sum(axis=0), self.max_context - 1
             )
         return tokens, counts
 
+    def spec_step_draft(
+        self, n_rounds: int = 8, draft_len: int = 7
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run ``n_rounds`` DRAFT-MODEL speculative rounds: the attached
+        small model (spec.DraftModel, int4 weights) proposes K tokens per
+        greedy slot and the serving model verifies them — propose,
+        verify, accept, draft-KV sync all inside ONE fused dispatch per
+        call (bulk draft catch-up for freshly admitted slots runs as
+        separate ingest dispatches first).
+
+        Returns (tokens [n_rounds, num_slots, draft_len+1],
+        counts [n_rounds, num_slots], proposed [n_rounds, num_slots]) —
+        tokens/counts exactly as ``spec_step``; ``proposed`` is the draft
+        tokens offered per (round, slot) (0 or draft_len), the honest
+        acceptance denominator for the per-proposer EWMA. Greedy slots
+        emit exactly the plain-greedy sequence; temp>0 slots never
+        speculate."""
+        if self.draft is None:
+            raise ValueError(
+                "no draft model attached (TPUEngine(draft=...) / "
+                "AIOS_TPU_DRAFT_MODEL)"
+            )
+        if not 1 <= draft_len <= spec.HISTORY_PAD - 2:
+            raise ValueError(
+                f"draft_len must be in [1, {spec.HISTORY_PAD - 2}]"
+            )
+        self._draft_catchup(headroom=draft_len + 1)
+        with self._lock:
+            if self.paged:
+                self._back_active_slots(n_rounds * (draft_len + 1))
+                args = (jnp.asarray(self.allocator.tables),)
+            else:
+                args = ()
+            self.state, self.draft_state, (tokens, counts, proposed) = (
+                self._draft_spec_fn(n_rounds, draft_len)(
+                    self.params, self.draft.params, self.state,
+                    self.draft_state, *args,
+                )
+            )
+            self.decode_steps += n_rounds
+            self._obs_decode_steps.inc(n_rounds)
+            self.spec_rounds += n_rounds
+            self.spec_proposer_rounds["draft"] += n_rounds
+            active_rounds = n_rounds * int(self.active.sum())
+            self.spec_slot_rounds += active_rounds
+        # readbacks OUTSIDE the lock (lock-readback discipline); the
+        # draft host-length mirror reads the post-dispatch device value
+        # rather than replaying R rounds of catchup/propose/clamp math
+        counts = np.asarray(counts)
+        tokens = np.asarray(tokens)
+        proposed = np.asarray(proposed)
+        d_len = np.asarray(self.draft_state["lengths"])
+        with self._lock:
+            emitted = int(counts[:, self.active].sum())
+            self.spec_tokens += emitted
+            self.spec_proposer_accepted["draft"] += max(
+                emitted - active_rounds, 0
+            )
+            self.draft_proposed_tokens += int(proposed[:, self.active].sum())
+            self._host_lengths = np.minimum(
+                self._host_lengths + counts.sum(axis=0), self.max_context - 1
+            )
+            self._draft_host_lengths = d_len.astype(np.int64)
+        return tokens, counts, proposed
+
+    def _draft_catchup(self, headroom: int) -> None:
+        """Bulk-ingest history into the draft KV until every active
+        slot's draft gap fits inside the fused rounds' per-round
+        catch-up width (``headroom``). Freshly admitted slots arrive
+        with a whole-prompt gap; each pass advances every lagging slot
+        by up to one ingest bucket. Dispatches all come from the
+        scheduler thread (like spec_step), so the host mirrors can't
+        race the device state."""
+        buckets = self._draft_ingest_buckets()
+        while True:
+            gaps = (
+                self._host_lengths - self._draft_host_lengths
+            )[self.active & self._host_greedy]
+            gap_max = int(gaps.max()) if gaps.size else 0
+            if gap_max <= headroom:
+                return
+            w = next((b for b in buckets if b >= gap_max), buckets[-1])
+            with self._lock:
+                self.draft_state = self._draft_ingest_fn(w)(
+                    self.draft.params, self.draft_state,
+                    self.state["history"], self.state["lengths"],
+                    self.state["active"], self.state["temps"],
+                )
+                self.draft_ingest_dispatches += 1
+            self._draft_host_lengths = np.asarray(
+                self.draft_state["lengths"]
+            ).astype(np.int64)
+
     def release(self, slot: int) -> None:
         self.active[slot] = False
         self._host_lengths[slot] = 0
+        self._draft_host_lengths[slot] = 0
+        self._host_greedy[slot] = False
         with self._lock:
             if self.allocator is not None:
                 self.allocator.free_slot(slot)  # pages recycle instantly
             self.state["lengths"] = self.state["lengths"].at[slot].set(0)
             self.state["active"] = self.state["active"].at[slot].set(False)
+            if self.draft_state is not None:
+                # the next occupant's draft KV rebuilds from history via
+                # ingest; zeroing the length is the whole reset
+                self.draft_state["lengths"] = (
+                    self.draft_state["lengths"].at[slot].set(0)
+                )
 
     def slot_length(self, slot: int) -> int:
         return int(self._host_lengths[slot])
@@ -2509,6 +2924,20 @@ class TPUEngine:
             out["spec_accepted"] = max(
                 self.spec_tokens - self.spec_slot_rounds, 0
             )
+            for p in spec.SPEC_PROPOSERS:
+                if self.spec_proposer_rounds[p]:
+                    out[f"spec_{p}_rounds"] = self.spec_proposer_rounds[p]
+                    out[f"spec_{p}_accepted"] = (
+                        self.spec_proposer_accepted[p]
+                    )
+        if self.draft is not None:
+            out["draft_ingest_dispatches"] = self.draft_ingest_dispatches
+            out["draft_proposed_tokens"] = self.draft_proposed_tokens
+            if self.draft_proposed_tokens:
+                out["draft_acceptance"] = round(
+                    self.spec_proposer_accepted["draft"]
+                    / self.draft_proposed_tokens, 3
+                )
         if self.jump_dispatches:
             out["jump_dispatches"] = self.jump_dispatches
             out["jump_tokens"] = self.jump_tokens
@@ -2573,8 +3002,11 @@ class TPUEngine:
             self._spec_fns.clear()
             self._restore_fns.clear()
             self._jump_fns.clear()
+            self._draft_fns.clear()
             self.state = {}
             self.params = None
+            self.draft = None  # DraftModel params may be pool-shared
+            self.draft_state = None
             self._attn_impl = None
         gc.collect()
 
@@ -2670,6 +3102,12 @@ class TPUEngine:
             self.compile_jump_fn(k)
         for n in spec_sizes:
             self.compile_spec_fn(n, spec_draft_len, spec_ngram)
+            # the draft proposer serves the same round sizes; its n-gram
+            # twin above stays warm too (the batcher's auto-disable
+            # ladder falls back draft -> ngram without a compile stall)
+            self.compile_draft_spec_fn(n, spec_draft_len)
+        if spec_sizes and self.draft is not None:
+            self.compile_draft_ingest_fns()
         if self.host_store is not None:
             # a restore chain is bounded by the prompt's full blocks AND
             # the pool; the last bucket rounds UP past capacity (a 10-page
@@ -2710,8 +3148,9 @@ class TPUEngine:
         """Single-request generation loop (the continuous-batching scheduler
         in engine/batching.py is the production path). ``speculative=True``
         decodes via n-gram speculative rounds (spec.py) — identical greedy
-        output, fewer dispatches; sampling requests fall back to plain
-        stepping on their own."""
+        output, fewer dispatches; ``speculative="draft"`` uses the
+        attached draft model instead; sampling requests fall back to
+        plain stepping on their own."""
         first = self.prefill(slot, token_ids, temperature, top_p)
         out = [first]
         while len(out) < max_new_tokens and out[-1] not in stop_tokens:
@@ -2721,9 +3160,14 @@ class TPUEngine:
                 break
             if speculative:
                 pre = self.slot_length(slot)  # before the dispatch mutates it
-                toks, counts = self.spec_step(
-                    min(budget, room), draft_len=draft_len, ngram=ngram
-                )
+                if speculative == "draft":
+                    toks, counts, _ = self.spec_step_draft(
+                        min(budget, room), draft_len=draft_len
+                    )
+                else:
+                    toks, counts = self.spec_step(
+                        min(budget, room), draft_len=draft_len, ngram=ngram
+                    )
                 flat: List[int] = []
                 for r in range(toks.shape[0]):
                     if pre >= self.max_context - 1:
@@ -2837,6 +3281,9 @@ class ChunkedPrefill:
                     *extra,
                 )
                 eng.active[self.slot] = True
+                eng._host_greedy[self.slot] = (
+                    self.temperature < sampling.GREEDY_EPS
+                )
                 eng._host_lengths[self.slot] = len(self.ids)
                 eng._register_prefix(self.slot, self.ids, self.hashes)
                 self.first_token = int(first)

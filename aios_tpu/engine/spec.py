@@ -31,9 +31,16 @@ llama-server (SURVEY.md section 2.3); built TPU-first instead of ported.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
+
+# The closed proposer enum: every aios_tpu_spec_* metric's ``proposer``
+# label, the batcher's fallback ladder (draft -> ngram) and the per-
+# proposer EWMA dicts all iterate THIS tuple — obs-lint pins it so the
+# label can never grow an unbounded dimension.
+SPEC_PROPOSERS = ("ngram", "draft")
 
 # Extra columns appended to the history buffer beyond max_context so the
 # post-verify scatter (rows lengths+1 .. lengths+1+K) never has to clamp —
@@ -110,6 +117,86 @@ def propose_ngram(
     drafts = jnp.take_along_axis(history, didx, axis=1)
     drafts = jnp.where(jnp.arange(K)[None, :] < num[:, None], drafts, -1)
     return drafts, num
+
+
+class DraftModel:
+    """Small-model draft proposer beside :func:`propose_ngram`.
+
+    The reference's intelligence hierarchy ships TinyLlama 1.1B alongside
+    the Mistral/DeepSeek/Qwen tiers as separate llama.cpp processes; here
+    the small tier becomes a true DRAFT MODEL for the serving tier
+    (RTP-LLM-style, PAPERS.md): it runs K autoregressive greedy steps per
+    speculative round and the serving model verifies the whole draft
+    through the existing ``model.verify_step(_paged)`` machinery in one
+    weight-bandwidth-bound dispatch. int4 weights (``ops/int4_matmul.py``
+    via the ``model.matmul`` ladder) keep the draft's HBM cost near-free
+    next to the serving tier's.
+
+    This object owns only the draft's CONFIG + quantized params (shared
+    read-only across a pool's replica engines); each engine materializes
+    its own slot-aligned KV state with :meth:`init_state` and keeps it in
+    sync on accept/reject/retire through the draft-spec graphs
+    (engine._draft_spec_impl). The sync invariant is simply that draft
+    cache rows ``[0, d_len)`` hold the K/V of ``history[:, 0:d_len)`` —
+    the same contract the serving cache keeps with its ``lengths`` — so
+    rejected draft rows become unreadable (and safely overwritable) the
+    moment ``d_len`` is clamped back to the verified length.
+
+    The draft must share the serving model's TOKENIZER: proposals are
+    token ids fed straight into the verify forward, so a vocab mismatch
+    is a config error, not a quality problem.
+    """
+
+    def __init__(self, cfg, params, *, quantize: Optional[str] = "int4"):
+        # deferred: engine.py imports this module at load time (the
+        # checkpoint.py cycle-safe pattern)
+        from . import model
+        from .engine import _is_prequantized, _prequantized_mode
+
+        self.cfg = cfg
+        if quantize is True:
+            quantize = "int8"
+        elif not quantize:
+            quantize = None
+        elif quantize not in ("int8", "int4"):
+            raise ValueError(f"unknown draft quantize mode {quantize!r}")
+        if _is_prequantized(params):
+            # a prepared checkpoint's STORED mode wins (the engine's
+            # _resolve_stored_mode convention): requantizing would need
+            # the dense source, which a prepared tree no longer carries
+            self.quant_mode = _prequantized_mode(params)
+        else:
+            if quantize is not None:
+                # fused single-chip serving layout: the draft only ever
+                # runs single-device (the engine refuses it under a
+                # sharding plan)
+                params = model.quantize_params(
+                    jax.tree.map(jnp.asarray, params), mode=quantize
+                )
+            self.quant_mode = quantize
+        self.params = jax.tree.map(jnp.asarray, params)
+
+    def init_state(self, num_slots: int, max_context: int,
+                   cache_dtype=jnp.bfloat16):
+        """Fresh slot-aligned draft decode state: a dense KV cache sized
+        to the SERVING model's context (rows map 1:1 onto history
+        columns) plus per-slot lengths. The draft tier is small, so the
+        dense layout costs little even beside a paged serving cache."""
+        from . import model
+
+        k, v = model.init_kv_cache(
+            self.cfg, num_slots, max_context, cache_dtype
+        )
+        return {
+            "k": k,
+            "v": v,
+            "lengths": jnp.zeros((num_slots,), jnp.int32),
+        }
+
+    def weight_bytes(self) -> int:
+        from . import model
+
+        return model.serving_weight_bytes(self.params)
 
 
 def accept_counts(drafts: jnp.ndarray, argmax_rows: jnp.ndarray) -> jnp.ndarray:

@@ -169,31 +169,34 @@ ENGINE_JUMP_TOKENS = Gauge(
     ("model",),
 )
 
-# -- n-gram speculative decoding (engine.spec_step; ROADMAP item) ----------
+# -- speculative decoding (engine.spec_step / spec_step_draft) -------------
 # Rounds/accepted are engine counters (WeakSet-summed like the jump
 # family); the acceptance ratio is the per-batcher EWMA driving the
 # AIOS_TPU_SPEC_MIN_ACCEPT auto-disable, averaged over live replica
-# batchers at scrape time.
+# batchers at scrape time. Every series carries the ``proposer`` label —
+# the CLOSED enum spec.SPEC_PROPOSERS (ngram | draft), pinned by
+# test_obs_lint — so the draft-model and prompt-lookup proposers read as
+# separate series and the ladder's fallbacks are visible in the metrics.
 
 SPEC_ROUNDS = Gauge(
     "aios_tpu_spec_rounds_total",
-    "Speculative verify rounds dispatched (monotonic, summed over "
-    "replica engines)",
-    ("model",),
+    "Speculative verify rounds dispatched by proposer (ngram|draft; "
+    "monotonic, summed over replica engines)",
+    ("model", "proposer"),
 )
 SPEC_ACCEPTED = Gauge(
     "aios_tpu_spec_accepted_total",
     "Draft tokens accepted by speculative verify (emitted tokens minus "
-    "the one guaranteed token per slot-round; monotonic, summed over "
-    "replica engines)",
-    ("model",),
+    "the one guaranteed token per slot-round; by proposer, monotonic, "
+    "summed over replica engines)",
+    ("model", "proposer"),
 )
 SPEC_ACCEPTANCE = Gauge(
     "aios_tpu_spec_acceptance_ratio",
-    "EWMA draft-acceptance ratio (accepted / proposed) per model, "
-    "averaged over replica batchers; drives the AIOS_TPU_SPEC_MIN_ACCEPT "
-    "auto-disable",
-    ("model",),
+    "EWMA draft-acceptance ratio (accepted / proposed) per model and "
+    "proposer, averaged over replica batchers; drives the per-proposer "
+    "AIOS_TPU_SPEC_MIN_ACCEPT auto-disable ladder",
+    ("model", "proposer"),
 )
 
 # -- prefix-cache host spill tier (engine/paged.py HostPageStore) ----------

@@ -416,7 +416,10 @@ class ModelManager:
         t0 = time.time()
         try:
             cfg, params, tokenizer = self._load_weights(name, path, context_length)
-            serving_cfg = ServingConfig.from_env(cfg.replicas)
+            serving_cfg = ServingConfig.from_env(
+                cfg.replicas,
+                draft_model_default=getattr(cfg, "draft_model", ""),
+            )
             n_replicas = max(1, serving_cfg.replicas)
             plans = self._replica_plans(n_replicas)
             # replicas on DISJOINT submeshes cost 1x per chip (each chip
@@ -429,6 +432,36 @@ class ModelManager:
                 repl_factor = 1
             cache_dtype = self.cache_dtype
             ctx = context_length or cfg.max_context
+            # Draft-model speculation (ModelConfig.draft_model /
+            # AIOS_TPU_DRAFT_MODEL / boot [models] draft_model): load the
+            # paired small model ONCE — its int4 params are shared
+            # read-only by every replica engine, each of which keeps its
+            # own slot-aligned draft KV state. Built BEFORE the HBM
+            # budget math below so the draft's weights + dense KV cache
+            # count against the per-chip budget like any co-resident
+            # footprint. A paired draft implies speculative serving for
+            # this model even when the global AIOS_TPU_SPECULATIVE knob
+            # is off (the draft exists for nothing else); the proposer
+            # ladder still carries the n-gram fallback.
+            draft = None
+            spec_on = self.speculative
+            draft_bytes = 0.0
+            if serving_cfg.draft_model:
+                draft = self._build_draft(
+                    serving_cfg.draft_model, cfg, ctx, tokenizer
+                )
+                if draft is not None:
+                    spec_on = True
+                    # weights are device-shared across replica engines
+                    # (one DraftModel object); the dense draft KV is
+                    # allocated PER ENGINE, and a draft only survives
+                    # with plan=None, where replicas share the device
+                    # set — so the KV term pays repl_factor times
+                    draft_bytes = (
+                        draft.weight_bytes()
+                        + self._kv_row_bytes(draft.cfg, jnp.bfloat16)
+                        * self.num_slots * ctx * repl_factor
+                    )
             kw = {}
             pool_rows = self.paged_pool_rows
             if pool_rows == "auto":
@@ -527,7 +560,7 @@ class ModelManager:
                 )
                 budget = (
                     _chip_hbm_bytes() * 0.85
-                    - weight_chip * repl_factor - resident
+                    - weight_chip * repl_factor - resident - draft_bytes
                 )
                 sp = self.plan.sp if self.plan is not None else 1
                 if kv_chip * repl_factor > max(budget, 0.0):
@@ -608,10 +641,11 @@ class ModelManager:
                         quantize=quantize,
                         cache_dtype=cache_dtype,
                         # the per-step history scatter serves only the
-                        # n-gram speculative proposer — skip it (and its
+                        # speculative proposers — skip it (and its
                         # serial scan dependency) when speculative
                         # serving is off
-                        track_history=self.speculative,
+                        track_history=spec_on,
+                        draft=draft,
                         **kw,
                     )
                     if self.warm_compile:
@@ -636,11 +670,13 @@ class ModelManager:
                 raise
             del params
 
-            def batcher_factory(eng, _tok=tokenizer):
+            def batcher_factory(eng, _tok=tokenizer, _spec=spec_on):
                 # the pool's spawn AND crash-respawn path — a replica
                 # whose scheduler died gets an identical fresh batcher
+                # (the proposer ladder re-resolves from eng.draft, so a
+                # respawned replica keeps its draft rung)
                 return ContinuousBatcher(
-                    eng, speculative=self.speculative, tokenizer=_tok
+                    eng, speculative=_spec, tokenizer=_tok
                 )
 
             try:
@@ -666,8 +702,10 @@ class ModelManager:
                 loaded_at=int(time.time()),
                 # every replica pins its own weights + KV; co-resident
                 # replicas (shared device set) multiply the per-chip
-                # footprint, disjoint submeshes pay 1x per chip
-                hbm_chip_bytes=hbm_estimate * repl_factor,
+                # footprint, disjoint submeshes pay 1x per chip.
+                # draft_bytes already carries its own replica factor
+                # (shared weights x1, per-engine KV x repl_factor)
+                hbm_chip_bytes=hbm_estimate * repl_factor + draft_bytes,
                 pool=pool,
                 model_path=path,
                 context_length=context_length or 0,
@@ -721,6 +759,72 @@ class ModelManager:
             else:
                 log.error("model %s failed to load: %s", name, exc)
             raise
+
+    def _build_draft(self, source: str, cfg: ModelConfig, ctx: int,
+                     tokenizer: BaseTokenizer):
+        """Resolve the paired draft model (a preset name like
+        "tinyllama" or a weights path) into an int4 spec.DraftModel, or
+        None when this deployment cannot carry one. Lenient like every
+        other serving knob: a bad pairing logs and falls back to n-gram
+        speculation instead of taking down the model load."""
+        from ..engine import spec as spec_mod
+
+        if self.plan is not None:
+            log.warning(
+                "%s: draft-model speculation is single-device only "
+                "(no shard_map twins for the draft graphs); serving "
+                "with n-gram speculation under AIOS_TPU_MESH", cfg.name,
+            )
+            return None
+        try:
+            p = Path(source)
+            if source.endswith(".gguf") or "/" in source or p.exists():
+                dcfg, dparams, dtok = self._load_weights(
+                    p.stem.lower() or "draft", source, 0
+                )
+            else:
+                dcfg, dparams, dtok = self._load_weights(source, "", 0)
+        except Exception as exc:  # noqa: BLE001 - lenient knob pattern
+            log.warning(
+                "%s: draft model %r failed to load (%s); serving with "
+                "n-gram speculation", cfg.name, source, exc,
+            )
+            return None
+        if dcfg.vocab_size != cfg.vocab_size:
+            log.warning(
+                "%s: draft model %s vocab (%d) does not match the "
+                "serving vocab (%d) — they must share one tokenizer; "
+                "serving with n-gram speculation",
+                cfg.name, dcfg.name, dcfg.vocab_size, cfg.vocab_size,
+            )
+            return None
+        # matching vocab SIZES do not imply the same tokenizer (32000 is
+        # every Llama-family size): a mismatched pairing would propose
+        # garbage ids with ~0 acceptance, and with the default
+        # spec_min_accept=0 the ladder would never fall back — a silent
+        # permanent throughput regression. Probe-encode through both.
+        try:
+            probe = 'The quick brown fox ran 42 {"tool": "call"}'
+            if dtok.encode(probe) != tokenizer.encode(probe):
+                log.warning(
+                    "%s: draft model %s tokenizes differently (same "
+                    "vocab size, different tokenizer) — draft proposals "
+                    "would be garbage ids; serving with n-gram "
+                    "speculation", cfg.name, dcfg.name,
+                )
+                return None
+        except Exception as exc:  # noqa: BLE001 - lenient knob pattern
+            log.warning(
+                "%s: draft tokenizer probe failed (%s); pairing on "
+                "vocab size alone", cfg.name, exc,
+            )
+        draft = spec_mod.DraftModel(dcfg, dparams, quantize="int4")
+        log.info(
+            "%s: paired draft model %s (%.0f MB serving weights, "
+            "ctx %d)", cfg.name, dcfg.name,
+            draft.weight_bytes() / 1e6, ctx,
+        )
+        return draft
 
     def _load_weights(self, name: str, path: str, context_length: int):
         """Resolve (config, params, tokenizer) from a model source."""

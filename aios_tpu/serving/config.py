@@ -71,9 +71,18 @@ class ServingConfig:
     # base of the failover exponential backoff (doubles per attempt,
     # +-50% jitter, capped at failover.MAX_BACKOFF_S)
     failover_backoff_ms: float = 50.0
+    # draft-model speculation source paired with this managed model
+    # (AIOS_TPU_DRAFT_MODEL overrides ModelConfig.draft_model): a preset
+    # name or weights path loaded as an int4 draft (engine/spec.py
+    # DraftModel). "" = n-gram prompt-lookup speculation only. The pool
+    # falls back to n-gram when it cannot carry a draft (dp-replicated
+    # pools, sharded plans, vocab mismatch) — see docs/ENGINE_PERF.md.
+    draft_model: str = ""
 
     @classmethod
-    def from_env(cls, replicas_default: int = 1) -> "ServingConfig":
+    def from_env(
+        cls, replicas_default: int = 1, draft_model_default: str = "",
+    ) -> "ServingConfig":
         replicas = _env_int("AIOS_TPU_REPLICAS", replicas_default, minimum=1)
         tps = _env_float("AIOS_TPU_TENANT_TOKENS_PER_SEC", 0.0)
         burst = _env_float("AIOS_TPU_TENANT_BURST_TOKENS", 0.0)
@@ -100,4 +109,7 @@ class ServingConfig:
             failover_backoff_ms=_env_float(
                 "AIOS_TPU_FAILOVER_BACKOFF_MS", 50.0
             ),
+            draft_model=os.environ.get(
+                "AIOS_TPU_DRAFT_MODEL", draft_model_default
+            ).strip(),
         )

@@ -306,6 +306,19 @@ def test_jit_warmup_waiver_honored():
     assert not _unwaived(_analyze(src, reg), "jit-warmup")
 
 
+def test_jit_warmup_covers_draft_module():
+    """ISSUE 11: the draft-model speculation module is serving-path —
+    the rule must watch it (today its graphs are jitted from engine.py
+    behind compile_draft_spec_fn/compile_draft_ingest_fns, which the
+    WARMUP_ROOT_RE compile_* root already matches; a stray jax.jit added
+    to spec.py itself must fail tier-1, not reach prod)."""
+    from aios_tpu.analysis import registry as live_reg
+
+    assert "aios_tpu.engine.spec" in live_reg.DISPATCH_HYGIENE_MODULES
+    assert live_reg.WARMUP_ROOT_RE.match("compile_draft_spec_fn")
+    assert live_reg.WARMUP_ROOT_RE.match("compile_draft_ingest_fns")
+
+
 # -- rule: silent-except (ISSUE 10) ------------------------------------------
 
 def _se_registry():

@@ -1,0 +1,384 @@
+"""Draft-model speculative decoding (engine/spec.py DraftModel,
+engine.spec_step_draft, the batcher's proposer ladder).
+
+The acceptance rule is exact for greedy requests, so the key contract is
+the same as n-gram speculation's: token IDENTITY with plain greedy
+decoding — a draft model (however good or bad) may only change how many
+dispatches a sequence takes, never the tokens. The identical-weights
+draft exercises the accept path (acceptance ~1.0) and a mismatched
+random draft exercises the reject/sync path (acceptance ~0.0); both must
+stream the exact plain-greedy sequence.
+
+Wall-clock discipline: the accept-path tests share ONE warmed
+module-scoped engine (the fused draft graphs compile once for the whole
+file); every test releases the slots it prefills.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu.engine import model, spec
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model.init_params(
+        TINY_TEST, jax.random.PRNGKey(1), dtype=jnp.float32
+    )
+
+
+@pytest.fixture(scope="module")
+def self_draft(params):
+    # identical weights, unquantized: greedy argmax agreement ~1.0, the
+    # deterministic accept-path fixture
+    return spec.DraftModel(TINY_TEST, params, quantize=None)
+
+
+@pytest.fixture(scope="module")
+def mismatched_draft():
+    # a different random model: proposals are mostly rejected, the
+    # deterministic reject/sync-path fixture
+    bad = model.init_params(TINY_TEST, jax.random.PRNGKey(9),
+                            dtype=jnp.float32)
+    return spec.DraftModel(TINY_TEST, bad, quantize=None)
+
+
+def make_engine(params, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return TPUEngine(TINY_TEST, params, **kw)
+
+
+PROMPT = [5, 9, 13, 27, 40]
+DL = 3  # draft_len for every dispatch in this file (shared graph keys)
+
+
+@pytest.fixture(scope="module")
+def draft_engine(params, self_draft):
+    """ONE warmed draft-paired engine shared by the accept-path tests.
+    Warmup + every batcher below use the same sizes (steps/rounds 2 and
+    4, draft_len DL), so the fused draft-spec, draft-ingest and n-gram
+    twin graphs compile exactly once for the whole module."""
+    eng = make_engine(params, draft=self_draft)
+    eng.warmup(step_sizes=(2, 4), prefill_chunk=0, spec_sizes=(2, 4),
+               spec_draft_len=DL)
+    yield eng
+    eng.close()
+
+
+def _batcher(eng, speculative, **kw):
+    return ContinuousBatcher(eng, chunk_steps=4, admit_chunk_steps=2,
+                             speculative=speculative, spec_draft_len=DL,
+                             **kw)
+
+
+@pytest.fixture(scope="module")
+def plain_ref(draft_engine):
+    # plain greedy on the SAME engine/params — the identity baseline
+    # (generate without speculative never touches the draft)
+    return draft_engine.generate(PROMPT, max_new_tokens=41, chunk=4)
+
+
+# ---------------------------------------------------------------------------
+# engine-level identity (accept path, reject path, paged + bulk ingest)
+# ---------------------------------------------------------------------------
+
+
+def test_draft_generate_matches_plain_greedy(draft_engine, plain_ref):
+    out = draft_engine.generate(PROMPT, max_new_tokens=41, chunk=4,
+                                speculative="draft", draft_len=DL)
+    assert out == plain_ref
+    st = draft_engine.stats()
+    # an identical draft accepts (nearly) everything: far fewer verify
+    # rounds than tokens, and a measured acceptance
+    assert st["spec_draft_rounds"] < len(plain_ref)
+    assert st["draft_acceptance"] > 0.6
+
+
+def test_mismatched_draft_still_token_identical(params, mismatched_draft,
+                                                plain_ref):
+    """The reject path IS the correctness path: a draft that agrees with
+    the serving model on (almost) nothing must still stream the exact
+    plain-greedy sequence — rejected rows fall beyond the clamped draft
+    length and the serving verify emits its own argmax."""
+    eng = make_engine(params, draft=mismatched_draft)
+    try:
+        out = eng.generate(PROMPT, max_new_tokens=41, chunk=4,
+                           speculative="draft", draft_len=DL)
+        assert out == plain_ref
+        assert eng.stats()["draft_acceptance"] < 0.5
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_paged_engine_bulk_ingest_identity(params, self_draft):
+    """Paged serving cache + a prompt longer than the fused rounds'
+    catch-up width: the draft KV rebuilds through the bucketed ingest
+    dispatches before the first propose, and the stream still matches
+    plain decode on the same paged layout. Slow tier: the dense-cache
+    tests above already exercise ingest tier-1 (PROMPT's gap exceeds
+    the catch-up width), this adds the paged-layout twin."""
+    long_prompt = [int(t) for t in
+                   np.random.RandomState(3).randint(1, 250, size=70)]
+    eng = make_engine(params, draft=self_draft,
+                      paged_pool_rows=4 * 128, page_size=16)
+    try:
+        # plain-path reference on the SAME engine (generate without
+        # speculative never touches the draft); the second run may HIT
+        # the prefix cache the first registered — prefix-hit admission
+        # identity is its own invariant (test_paged), and the draft's
+        # history still backfills so the ingest path is exercised
+        ref = eng.generate(long_prompt, max_new_tokens=24, chunk=4)
+        out = eng.generate(long_prompt, max_new_tokens=24, chunk=4,
+                           speculative="draft", draft_len=DL)
+        assert out == ref
+        assert eng.draft_ingest_dispatches >= 1
+        assert int(eng._draft_host_lengths[0]) == 0  # released
+    finally:
+        eng.close()
+
+
+def test_draft_sampling_slots_one_token_per_round(draft_engine):
+    """temp > 0 slots never draft: proposed stays 0, each round emits
+    exactly one (sampled) token — numerically a plain decode step — and
+    the draft pays NOTHING for them: neither catch-up nor ingest builds
+    draft KV the ok gate guarantees is never read."""
+    eng = draft_engine
+    eng.prefill(0, PROMPT, temperature=0.9, top_p=0.95)
+    eng.prefill(1, PROMPT, temperature=0.0)  # greedy co-resident
+    try:
+        tokens, counts, proposed = eng.spec_step_draft(4, draft_len=DL)
+        assert counts.shape == (4, 4)
+        assert (counts[:, 0] == 1).all()
+        assert (proposed[:, 0] == 0).all()
+        # the sampled slot's draft KV was never built...
+        assert int(np.asarray(eng.draft_state["lengths"])[0]) == 0
+        # ...while the greedy co-resident's was (and proposed)
+        assert int(np.asarray(eng.draft_state["lengths"])[1]) > 0
+        assert proposed[:, 1].sum() > 0
+    finally:
+        eng.release(0)
+        eng.release(1)
+    # release() resets the draft mirror for the next occupant
+    assert int(np.asarray(eng.draft_state["lengths"])[1]) == 0
+    assert int(eng._draft_host_lengths[1]) == 0
+
+
+def test_draft_vocab_mismatch_raises(params):
+    bad_cfg = TINY_TEST.scaled(vocab_size=TINY_TEST.vocab_size * 2)
+    bad = spec.DraftModel(
+        bad_cfg,
+        model.init_params(bad_cfg, jax.random.PRNGKey(2),
+                          dtype=jnp.float32),
+        quantize=None,
+    )
+    with pytest.raises(ValueError, match="vocab"):
+        make_engine(params, draft=bad)
+
+
+def test_draft_requires_history_falls_back(params, self_draft):
+    """track_history=False cannot carry any speculative proposer; the
+    draft detaches with a warning instead of corrupting state."""
+    eng = make_engine(params, draft=self_draft, track_history=False)
+    try:
+        assert eng.draft is None
+        with pytest.raises(ValueError, match="draft"):
+            eng.spec_step_draft(1)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# batcher: proposer ladder, auto-disable fallback, knobs
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_draft_greedy_identical(draft_engine):
+    """Draft speculation through the production batcher, multi-request,
+    vs a plain batcher on the SAME engine: identical greedy streams."""
+    prompts = [[3 + i, 7, 11] for i in range(3)]
+
+    def wave(speculative):
+        b = _batcher(draft_engine, speculative)
+        try:
+            handles = [
+                b.submit(Request(prompt_ids=p, max_tokens=20,
+                                 temperature=0.0))
+                for p in prompts
+            ]
+            return [h.tokens() for h in handles], b.spec_proposers
+        finally:
+            b.shutdown()
+
+    ref, _ = wave(False)
+    rounds0 = draft_engine.spec_proposer_rounds["draft"]
+    out, proposers = wave(True)
+    assert out == ref
+    assert proposers == ("draft", "ngram")
+    assert draft_engine.spec_proposer_rounds["draft"] > rounds0
+
+
+def test_ladder_falls_draft_to_ngram_to_off(draft_engine):
+    """Per-proposer auto-disable: a collapsed draft EWMA suspends ONLY
+    the draft rung (n-gram keeps serving); a collapsed n-gram EWMA then
+    turns speculation off — and each proposer re-probes on its own
+    window. Unit drive, no dispatches."""
+    b = _batcher(draft_engine, True, spec_min_accept=0.5)
+    try:
+        assert b._spec_proposer() == "draft"
+        counts = np.ones((2, 4), np.int64)
+        proposed = np.full((2, 4), DL, np.int64)
+        b._spec_measure("draft", counts, {0: 2, 1: 2}, proposed)
+        assert b.spec_ewma["draft"] == 0.0
+        assert b._spec_proposer() == "ngram", (
+            "a collapsed draft must fall back to n-gram, not to off"
+        )
+        b._spec_measure("ngram", counts, {0: 2, 1: 2})
+        assert b._spec_proposer() is None and not b._spec_active()
+        # the draft's window expires first -> the draft rung returns
+        b._spec_off_until["draft"] = time.monotonic() - 1
+        assert b._spec_proposer() == "draft"
+        # ... but with no greedy slot live the tick skips the draft rung
+        assert b._spec_proposer(greedy_live=False) is None
+    finally:
+        b.shutdown()
+
+
+def test_draft_acceptance_denominator_counts_only_proposals(draft_engine):
+    """Sampled-heavy batches must not read as draft rejection: rounds
+    where nothing was proposed contribute nothing to the denominator."""
+    b = _batcher(draft_engine, True, spec_min_accept=0.5)
+    try:
+        counts = np.ones((2, 4), np.int64)
+        proposed = np.zeros((2, 4), np.int64)  # nothing offered
+        b._spec_measure("draft", counts, {0: 2, 1: 2}, proposed)
+        assert b.spec_ewma["draft"] is None  # no measurement, no verdict
+        assert b._spec_proposer() == "draft"
+    finally:
+        b.shutdown()
+
+
+def test_reprobe_env_knob(draft_engine, monkeypatch):
+    monkeypatch.setenv("AIOS_TPU_SPEC_REPROBE_SECS", "3.5")
+    b = _batcher(draft_engine, True)
+    try:
+        assert b.spec_reprobe_secs == 3.5
+    finally:
+        b.shutdown()
+    monkeypatch.setenv("AIOS_TPU_SPEC_REPROBE_SECS", "junk")
+    b = _batcher(draft_engine, True)
+    try:
+        assert b.spec_reprobe_secs == 10.0  # lenient fallback
+    finally:
+        b.shutdown()
+
+
+def test_no_compile_after_warmup_with_draft(draft_engine):
+    """The PR 6 flat-compile-counters invariant extended to the draft
+    graphs: warmup + batcher attach AOT-compiled the fused draft-spec
+    and ingest graphs (module fixture), so serving a draft-speculated
+    stream compiles NOTHING new. Runs LAST of the shared-engine tests —
+    the snapshot covers whatever earlier tests built."""
+    eng = draft_engine
+    b = _batcher(eng, True)
+    try:
+        compiles = eng.stats()["xla_compiles"]
+        rounds0 = eng.spec_proposer_rounds["draft"]
+        out = b.submit(Request(prompt_ids=PROMPT, max_tokens=16,
+                               temperature=0.0)).tokens()
+        assert len(out) == 16
+        assert eng.spec_proposer_rounds["draft"] > rounds0
+        assert eng.stats()["xla_compiles"] == compiles, (
+            "a draft-speculated stream compiled mid-serving"
+        )
+    finally:
+        b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# live-gRPC e2e: draft ON vs OFF byte-identical through the full stack
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_e2e_grpc_draft_on_off_identical(monkeypatch):
+    """ISSUE 11 acceptance: the full serving stack (RuntimeService ->
+    pool -> batcher -> engine) with AIOS_TPU_DRAFT_MODEL paired streams
+    byte-identical greedy completions to the same stack on the n-gram
+    proposer, with compile counters flat through serving (the warmup
+    gate covers the draft graphs).
+
+    Why draft-vs-ngram and not draft-vs-plain here: a greedy slot's
+    emitted chain is [g_0, g_1, ...] — the verify forward's own argmax
+    at each accepted position — which is a pure function of the prefix
+    and INDEPENDENT of what any proposer offered (acceptance admits a
+    draft token iff it equals that argmax). So the two spec stacks must
+    match to the byte in ANY dtype, while spec-vs-plain additionally
+    requires verify_step/decode_step argmax agreement — exact in the
+    fp32 unit tests above, but bf16 near-ties on synthetic random
+    weights (this stack's serving dtype) can legally flip it."""
+    from aios_tpu import rpc, services
+    from aios_tpu.proto_gen import runtime_pb2
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    monkeypatch.setenv("AIOS_TPU_SPECULATIVE", "1")
+
+    def run_stack(draft: str):
+        if draft:
+            monkeypatch.setenv("AIOS_TPU_DRAFT_MODEL", draft)
+        else:
+            monkeypatch.delenv("AIOS_TPU_DRAFT_MODEL", raising=False)
+        manager = ModelManager(num_slots=2, warm_compile=True)
+        server, service, port = serve(
+            address="127.0.0.1:0", manager=manager, block=False
+        )
+        try:
+            channel = rpc.insecure_channel(f"127.0.0.1:{port}")
+            stub = services.AIRuntimeStub(channel)
+            status = stub.LoadModel(runtime_pb2.LoadModelRequest(
+                model_name="tiny-draft-e2e",
+                model_path="synthetic://tiny-test",
+                context_length=128,
+            ))
+            assert status.status == "ready"
+            managed = manager.get("tiny-draft-e2e")
+            compiles = managed.engine.stats()["xla_compiles"]
+            texts = []
+            for prompt in ("hello there", "draft me"):
+                # temperature 0 maps to the service's 0.7 default
+                # (reference parity); a positive sub-GREEDY_EPS value
+                # survives the mapping AND decodes greedy
+                chunks = list(stub.StreamInfer(runtime_pb2.InferRequest(
+                    prompt=prompt, max_tokens=12, temperature=1e-6,
+                    model="tiny-draft-e2e",
+                )))
+                texts.append("".join(c.text for c in chunks))
+            stats = managed.engine.stats()
+            assert stats["xla_compiles"] == compiles, (
+                "serving compiled new graphs past the readiness gate"
+            )
+            return texts, stats
+        finally:
+            server.stop(grace=None)
+
+    on_texts, on_stats = run_stack("tiny-test")
+    off_texts, off_stats = run_stack("")
+    assert on_texts == off_texts, (
+        "the draft proposer changed a greedy stream"
+    )
+    assert on_stats.get("spec_draft_rounds", 0) > 0, (
+        "the draft proposer never actually served"
+    )
+    assert off_stats.get("spec_ngram_rounds", 0) > 0, (
+        "the control stack never actually speculated"
+    )

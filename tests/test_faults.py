@@ -599,6 +599,109 @@ def test_failover_handle_cancel_stops_retries():
 
 
 # ---------------------------------------------------------------------------
+# failover with a DRAFT MODEL attached (ISSUE 11): the draft KV is
+# replica-local derived state — a crash mid-spec-round must fail over
+# with the resumed stream token-identical and the surviving replica's
+# draft rebuilt from history (bulk ingest) or cleanly reset.
+# ---------------------------------------------------------------------------
+
+DRAFT_MODEL = "draft-failover-test"
+
+
+@pytest.fixture(scope="module")
+def draft_pool():
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine import spec as spec_mod
+    from aios_tpu.engine.batching import ContinuousBatcher
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.serving import ReplicaPool, ServingConfig
+
+    cfg = TINY_TEST.scaled(name=DRAFT_MODEL, max_context=256)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    draft = spec_mod.DraftModel(cfg, params, quantize=None)
+    engines = [
+        TPUEngine(cfg, params, num_slots=2, max_context=256,
+                  cache_dtype=jnp.float32, draft=draft)
+        for _ in range(2)
+    ]
+    pool = ReplicaPool(
+        DRAFT_MODEL, engines,
+        lambda e: ContinuousBatcher(e, chunk_steps=2, admit_chunk_steps=2,
+                                    speculative=True, spec_draft_len=3),
+        ServingConfig(replicas=2, failover_retries=2),
+    )
+    yield pool
+    pool.shutdown()
+
+
+def test_draft_failover_crash_mid_spec_round_streams_identical(draft_pool):
+    """A scheduler crash injected mid-SPEC-round on a draft-enabled
+    2-replica pool: the failover controller resumes every greedy stream
+    token-identically on the surviving replica — whose draft KV for the
+    resumed slot starts empty and rebuilds from the re-prefilled history
+    via bulk ingest — with zero stuck requests and a counted respawn."""
+    pool = draft_pool
+    ref, ref_handles, stuck = _wave(pool, "dref")
+    assert stuck == 0 and all(len(s) == 24 for s in ref)
+    assert not any(h.aborted for h in ref_handles)
+    # the reference wave really served through the draft proposer
+    assert any(
+        r.engine.spec_proposer_rounds["draft"] > 0 for r in pool.replicas
+    )
+
+    restarts_before = pool.restarts
+    # nth:4 counts DECODE ticks — with chunk_steps=2 and spec rounds the
+    # 4th live tick lands mid-stream, well inside the spec-serving phase
+    faults.activate("seed=11;pool.scheduler_crash=nth:4")
+    try:
+        out, handles, stuck = _wave(pool, "dcrash")
+    finally:
+        faults.deactivate()
+    assert stuck == 0, "a request leaked through the crash"
+    assert out == ref, (
+        "draft-mode failover streams must be token-identical"
+    )
+    assert not any(h.aborted for h in handles)
+    assert pool.restarts == restarts_before + 1
+    tls = [
+        t for t in flightrec.RECORDER.recent(model=DRAFT_MODEL, limit=64)
+        if t.request_id.startswith("dcrash-")
+    ]
+    assert len(tls) == 4
+    assert all(t.state == "retired" for t in tls)
+    assert any(
+        k == "failover" for t in tls for _, k, _ in t.events
+    ), "no failover event recorded on any timeline"
+    # every replica's draft mirror is back in a clean state (all slots
+    # released after the wave -> lengths zeroed)
+    for r in pool.replicas:
+        assert (r.engine._draft_host_lengths == 0).all()
+        assert (np.asarray(r.engine.draft_state["lengths"]) == 0).all()
+
+
+def test_draft_faults_disabled_streams_and_compiles_pinned(draft_pool):
+    """The PR 8/10 pinned invariant re-asserted with a draft model
+    attached: no schedule armed -> the same wave twice is
+    token-identical, no fault fires, and the engines compile NOTHING new
+    (the draft graphs were built on the first wave's dispatch sizes and
+    stay warm)."""
+    pool = draft_pool
+    a, _, _ = _wave(pool, "dquiet-a")
+    compiles = [r.engine.stats()["xla_compiles"] for r in pool.replicas]
+    b, _, _ = _wave(pool, "dquiet-b")
+    assert a == b
+    assert faults.fired() == []
+    assert [
+        r.engine.stats()["xla_compiles"] for r in pool.replicas
+    ] == compiles
+
+
+# ---------------------------------------------------------------------------
 # engine-level restore fallback + corruption (slow tier — real spills)
 # ---------------------------------------------------------------------------
 

@@ -199,7 +199,8 @@ def test_spec_family_complete_and_typed():
     exist, with the promised kinds — rounds/accepted are WeakSet-summed
     engine counters; the acceptance ratio is the per-batcher EWMA that
     drives the AIOS_TPU_SPEC_MIN_ACCEPT auto-disable, averaged over
-    replica batchers."""
+    replica batchers. Since the draft-model proposer landed, every
+    series carries the (model, proposer) label pair."""
     family = {
         m.name: m.kind for m in _catalog()
         if m.name.startswith("aios_tpu_spec_")
@@ -207,9 +208,33 @@ def test_spec_family_complete_and_typed():
     assert family == SPEC_EXPECTED
     for m in _catalog():
         if m.name.startswith("aios_tpu_spec_"):
-            assert tuple(m.labelnames) == ("model",), (
-                f"{m.name}: spec metrics carry exactly the model label"
+            assert tuple(m.labelnames) == ("model", "proposer"), (
+                f"{m.name}: spec metrics carry exactly the "
+                f"(model, proposer) label pair"
             )
+
+
+def test_spec_proposers_are_a_closed_enum():
+    """The ``proposer`` label values come from spec.SPEC_PROPOSERS and
+    nowhere else — the engine and batcher gauge registrations iterate
+    the tuple (the SLO OBJECTIVES pattern), so a new proposer is a
+    reviewed enum change, not a stray string that grows the label set."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu.engine import batching, engine, spec
+
+    assert spec.SPEC_PROPOSERS == ("ngram", "draft")
+    mi = module_info_for(engine)
+    fn = mi.functions["TPUEngine._register_gauges"]
+    assert "SPEC_PROPOSERS" in names_used_in(fn.node), (
+        "engine spec gauges must be registered by iterating the "
+        "SPEC_PROPOSERS enum"
+    )
+    bi = module_info_for(batching)
+    init = bi.functions["ContinuousBatcher.__init__"]
+    assert "SPEC_PROPOSERS" in names_used_in(init.node), (
+        "batcher acceptance gauges must be registered by iterating the "
+        "SPEC_PROPOSERS enum"
+    )
 
 
 # -- the decode dispatch family (pipelined batcher, engine/batching.py) ----

@@ -462,35 +462,47 @@ def test_radix_escape_hatch_selects_flat_index(params):
 
 def test_spec_ewma_autodisable_and_reprobe(params):
     """Deterministic unit drive of the EWMA machinery: zero acceptance
-    under a positive floor suspends speculation; an expired window
-    resets the EWMA so one probe dispatch re-decides."""
+    under a positive floor suspends the proposer; an expired window
+    grants a PROBE-COUNT-SEEDED re-probe — the floor re-judges only
+    after SPEC_PROBE_DISPATCHES probe dispatches accumulate into a
+    fresh cumulative average, so one unlucky probe can no longer
+    re-disable instantly (the old zeroed-EWMA behavior)."""
+    from aios_tpu.engine.batching import SPEC_PROBE_DISPATCHES
+
     eng = TPUEngine(TINY_TEST, params, num_slots=4, max_context=128,
                     cache_dtype=jnp.float32)
     b = ContinuousBatcher(eng, speculative=True, spec_min_accept=0.5)
     try:
-        assert b._spec_active()
+        assert b.spec_proposers == ("ngram",)
+        assert b._spec_active() and b._spec_proposer() == "ngram"
         # a dispatch where every live slot emitted exactly 1 token/round
         counts = np.ones((2, 4), np.int64)
-        b._spec_measure(counts, {0: 2, 1: 2})
-        assert b.spec_ewma == 0.0
+        b._spec_measure("ngram", counts, {0: 2, 1: 2})
+        assert b.spec_ewma["ngram"] == 0.0
         assert b.spec_autodisables == 1
         assert not b._spec_active()
-        # window expiry -> one probe decides on FRESH evidence
-        b._spec_off_until = time.monotonic() - 1
+        # window expiry -> fresh evidence, judged over the probe budget
+        b._spec_off_until["ngram"] = time.monotonic() - 1
         assert b._spec_active()
-        assert b.spec_ewma is None
-        # a healthy probe (full acceptance) keeps speculation on
+        assert b.spec_ewma["ngram"] is None
+        assert b._spec_probe_left["ngram"] == SPEC_PROBE_DISPATCHES
+        # one BAD probe (the fix this knob exists for): verdict deferred
+        b._spec_measure("ngram", counts, {0: 2, 1: 2})
+        assert b._spec_active(), "one bad probe must not re-disable"
         full = np.full((2, 4), b.spec_draft_len + 1, np.int64)
-        b._spec_measure(full, {0: 2, 1: 2})
-        assert b.spec_ewma == 1.0 and b._spec_active()
+        b._spec_measure("ngram", full, {0: 2, 1: 2})
+        b._spec_measure("ngram", full, {0: 2, 1: 2})
+        # cumulative probe average (0 + 1 + 1) / 3 clears the floor
+        assert b._spec_active()
+        assert abs(b.spec_ewma["ngram"] - 2.0 / 3.0) < 1e-9
         # rounds past a slot's retirement are EXCLUDED: slot 0 retired
         # after round 1, its round-2 zero-acceptance column must not
         # drag the (perfect) served acceptance down
-        b.spec_ewma = None
+        b.spec_ewma["ngram"] = None
         mixed = np.full((2, 4), b.spec_draft_len + 1, np.int64)
         mixed[1, 0] = 1  # unserved continuation round, nothing accepted
-        b._spec_measure(mixed, {0: 1, 1: 2})
-        assert b.spec_ewma == 1.0 and b._spec_active()
+        b._spec_measure("ngram", mixed, {0: 1, 1: 2})
+        assert b.spec_ewma["ngram"] == 1.0 and b._spec_active()
     finally:
         b.shutdown()
         eng.close()
@@ -514,7 +526,7 @@ def test_spec_autodisable_end_to_end_sampled(params):
         assert b.spec_autodisables >= 1
         # re-arm the window so a slow container can't expire it (and
         # trigger a legitimate re-probe) before the next request drains
-        b._spec_off_until = time.monotonic() + 300
+        b._spec_off_until["ngram"] = time.monotonic() + 300
         rounds = eng.spec_rounds
         out2 = b.submit(Request(
             prompt_ids=[9, 4, 33], max_tokens=12, temperature=0.9,
