@@ -56,6 +56,16 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             # floor skips the tier for chains shorter than N pages.
             "prefix_host_bytes": "",
             "host_restore_min_pages": "",
+            # long-context tier (docs/ENGINE_PERF.md): window+sink KV
+            # compression — past kv_compress_after rows a slot's paged KV
+            # prunes to kv_sink_pages leading + kv_window_pages trailing
+            # pages ("" / 0 = off, exact full attention); prompts >=
+            # seq_prefill_min rows prefill in one dispatch sharded over
+            # the mesh's sp axis ("" / 0 = off; needs sp > 1 in mesh).
+            "kv_compress_after": "",
+            "kv_sink_pages": "",
+            "kv_window_pages": "",
+            "seq_prefill_min": "",
             "speculative": False,    # n-gram speculative decode
             # draft-model speculation: pair each managed model with a
             # small draft (preset name or weights path, e.g. "tinyllama")
@@ -266,6 +276,13 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         # serving default of 2)
         ("failover_retries", "AIOS_TPU_FAILOVER_RETRIES", True),
         ("failover_backoff_ms", "AIOS_TPU_FAILOVER_BACKOFF_MS", False),
+        # long-context tier: an explicit kv_compress_after / seq_prefill
+        # 0 forwards (compression / sp-sharded prefill OFF, overriding a
+        # ModelConfig default)
+        ("kv_compress_after", "AIOS_TPU_KV_COMPRESS_AFTER", True),
+        ("kv_sink_pages", "AIOS_TPU_KV_SINK_PAGES", False),
+        ("kv_window_pages", "AIOS_TPU_KV_WINDOW_PAGES", False),
+        ("seq_prefill_min", "AIOS_TPU_SEQ_PREFILL_MIN", True),
     ):
         raw = m.get(cfg_key, "")
         if raw in ("", None):

@@ -829,6 +829,24 @@ class ContinuousBatcher:
                 need_rows = min(
                     need_rows, window + self.prefill_chunk + alloc.page_size
                 )
+            elif (
+                alloc is not None
+                and getattr(self.engine, "kv_compress_armed", False)
+                and self.prefill_chunk is not None
+            ):
+                # window+sink KV compression prunes mid-admission the
+                # same way: a prompt longer than the pool still admits,
+                # peaking at sink + window + one in-flight chunk (plus a
+                # page of straddle per boundary) — this is what opens
+                # long-document prompts beyond the per-slot pool share
+                comp_rows = (
+                    self.engine.kv_sink_pages + self.engine.kv_window_pages
+                ) * alloc.page_size
+                need_rows = min(
+                    need_rows,
+                    max(self.engine.kv_compress_after, comp_rows)
+                    + self.prefill_chunk + 2 * alloc.page_size,
+                )
             if alloc is not None and alloc.blocks_for(
                 need_rows
             ) > alloc.capacity_blocks():

@@ -86,6 +86,33 @@ class ModelConfig:
     # partial-node overlap credit for the router. False = the legacy flat
     # hash-chain map (escape hatch). AIOS_TPU_PREFIX_RADIX overrides.
     prefix_radix: bool = True
+    # Long-context tier (docs/ENGINE_PERF.md "Long-context tier"):
+    # window+sink KV compression — once a slot's length exceeds this many
+    # rows, its paged KV is pruned to kv_sink_pages leading pages (the
+    # attention sinks) plus a sliding window of kv_window_pages trailing
+    # pages; the freed middle pages return to the pool and decode masks
+    # attend only to the live rows (SnapStream/StreamingLLM-style,
+    # PAPERS.md). 0 = off (exact full attention). Below the threshold
+    # streams are token-exact; above it they are a deterministic
+    # approximation. Paged engines with an unreplicated pool only.
+    # AIOS_TPU_KV_COMPRESS_AFTER overrides at load time.
+    kv_compress_after: int = 0
+    # leading pages kept live under KV compression (attention sinks —
+    # the first tokens anchor the softmax; >= 1).
+    # AIOS_TPU_KV_SINK_PAGES overrides.
+    kv_sink_pages: int = 1
+    # trailing sliding-window pages kept live under KV compression
+    # (>= 1). AIOS_TPU_KV_WINDOW_PAGES overrides.
+    kv_window_pages: int = 8
+    # sequence-sharded prefill (parallel/ring_attention.py / ulysses.py):
+    # prompts at least this many rows long prefill in ONE dispatch with
+    # the sequence sharded over the mesh's sp axis instead of serially
+    # through chunked admission — the whole mesh works one huge prompt's
+    # prefill, and the resulting KV scatters back into the normal paged
+    # layout so decode/prefix-cache/spill/failover see nothing new.
+    # 0 = off. Needs a sharding plan with sp > 1 and an unreplicated
+    # paged pool. AIOS_TPU_SEQ_PREFILL_MIN overrides.
+    seq_prefill_min: int = 0
 
     @property
     def moe(self) -> bool:

@@ -227,6 +227,24 @@ def _env_flag(name: str) -> Optional[bool]:
     return raw in ("1", "true", "on", "yes")
 
 
+def _env_int(name: str) -> Optional[int]:
+    """Lenient env integer: None when unset/blank/malformed (a bad knob
+    logs and falls back instead of failing a model load — the
+    serving-config convention)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        v = int(float(raw))
+        if v < 0:
+            raise ValueError("must be >= 0")
+        return v
+    except ValueError:
+        log.warning("%s=%r ignored (expected a non-negative integer)",
+                    name, raw)
+        return None
+
+
 # Device-resident decode state, threaded through the jitted cores as one
 # donated pytree: {k, v, lengths, last_tokens, temps, top_ps, key}
 DecodeState = Dict[str, jnp.ndarray]
@@ -298,6 +316,10 @@ class TPUEngine:
         unified_step: Optional[bool] = None,  # one dynamic-n decode graph
         prefix_radix: Optional[bool] = None,  # radix-tree prefix index
         draft: Optional["spec.DraftModel"] = None,  # draft-model proposer
+        kv_compress_after: Optional[int] = None,  # window+sink threshold rows
+        kv_sink_pages: Optional[int] = None,  # live leading (sink) pages
+        kv_window_pages: Optional[int] = None,  # live trailing window pages
+        seq_prefill_min: Optional[int] = None,  # sp-sharded prefill floor rows
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -618,6 +640,124 @@ class TPUEngine:
         # dp-partitioned pool those need a shard_map twin that does not
         # exist yet — refuse rather than corrupt replica-local pages
         self.spec_supported = not (self.paged and self.pool_replicas > 1)
+
+        # -- Long-context tier (docs/ENGINE_PERF.md "Long-context tier") --
+        # (1) Window+sink KV compression: past kv_compress_after rows a
+        # slot's paged KV prunes to kv_sink_pages leading pages plus a
+        # kv_window_pages trailing window (SnapStream/StreamingLLM-style,
+        # PAPERS.md) — freed pages return to the pool (or survive under
+        # their prefix-index references and spill through the PR 4 host
+        # tier), and every attention graph masks the pruned middle via a
+        # per-slot window-start operand that rides beside the page
+        # tables. win_start = 0 keeps the mask a no-op, so below the
+        # threshold streams are token-exact.
+        def knob(explicit, env, default):
+            # explicit constructor arg > env > ModelConfig default — the
+            # unified_step/prefix_radix resolution convention
+            if explicit is not None:
+                return int(explicit)
+            v = _env_int(env)
+            return int(default) if v is None else v
+
+        self.kv_compress_after = knob(
+            kv_compress_after, "AIOS_TPU_KV_COMPRESS_AFTER",
+            getattr(cfg, "kv_compress_after", 0),
+        )
+        self.kv_sink_pages = max(knob(
+            kv_sink_pages, "AIOS_TPU_KV_SINK_PAGES",
+            getattr(cfg, "kv_sink_pages", 1),
+        ), 1)
+        self.kv_window_pages = max(knob(
+            kv_window_pages, "AIOS_TPU_KV_WINDOW_PAGES",
+            getattr(cfg, "kv_window_pages", 8),
+        ), 1)
+        self.kv_compress_armed = False
+        self._sink_rows = 0
+        if self.kv_compress_after > 0:
+            if not self.paged or self.pool_replicas > 1:
+                log.warning(
+                    "%s: kv_compress_after needs a paged, unreplicated "
+                    "KV pool; compression disabled", cfg.name,
+                )
+            elif cfg.sliding_window is not None:
+                log.warning(
+                    "%s: kv_compress_after is redundant under a model "
+                    "sliding window (residency is already bounded); "
+                    "compression disabled", cfg.name,
+                )
+            else:
+                P = self.allocator.page_size
+                # the pruned mask needs sink + window to fit under the
+                # threshold, or an armed slot could prune rows it is
+                # still token-exactly below the threshold for
+                floor = (self.kv_sink_pages + self.kv_window_pages) * P
+                if self.kv_compress_after < floor:
+                    log.info(
+                        "%s: kv_compress_after %d raised to sink+window "
+                        "floor %d", cfg.name, self.kv_compress_after,
+                        floor,
+                    )
+                    self.kv_compress_after = floor
+                self.kv_compress_armed = True
+                self._sink_rows = self.kv_sink_pages * P
+        # per-slot live-window start in ROWS (0 = uncompressed); rides
+        # beside the page tables as a dispatch operand, never in the
+        # donated state
+        self._win_starts = np.zeros(num_slots, dtype=np.int32)
+        self.kv_compress_slots = 0  # slots that crossed the threshold
+        self.kv_pages_pruned = 0  # pages released by pruning
+
+        # (2) Sequence-sharded prefill: prompts >= seq_prefill_min rows
+        # prefill in ONE dispatch with the sequence sharded over the
+        # mesh's sp axis (parallel/ring_attention.py make_ring_attn_fn /
+        # ulysses.py make_ulysses_attn_fn) instead of serially through
+        # chunked admission; the resulting KV scatters back into the
+        # normal paged layout so decode, prefix-cache insertion,
+        # spill/restore and failover see nothing new.
+        self.seq_prefill_min = knob(
+            seq_prefill_min, "AIOS_TPU_SEQ_PREFILL_MIN",
+            getattr(cfg, "seq_prefill_min", 0),
+        )
+        self._seq_attn = None
+        self._seq_prefill_fns: Dict[int, object] = {}
+        self.prefill_seq_sharded = 0
+        if self.seq_prefill_min > 0:
+            sp = shardings.sp if shardings is not None else 1
+            if not self.paged or self.pool_replicas > 1 or sp <= 1:
+                log.warning(
+                    "%s: seq_prefill_min needs a paged, unreplicated "
+                    "pool and a sharding plan with sp > 1; "
+                    "sequence-sharded prefill disabled", cfg.name,
+                )
+                self.seq_prefill_min = 0
+            else:
+                impl = os.environ.get(
+                    "AIOS_TPU_SEQ_PREFILL_IMPL", "ring"
+                ).strip().lower() or "ring"
+                if impl == "ulysses" and (
+                    cfg.num_heads % sp or cfg.num_kv_heads % sp
+                ):
+                    log.warning(
+                        "%s: ulysses seq prefill needs heads (%d/%d) "
+                        "divisible by sp=%d; using ring", cfg.name,
+                        cfg.num_heads, cfg.num_kv_heads, sp,
+                    )
+                    impl = "ring"
+                if impl == "ulysses":
+                    from ..parallel.ulysses import make_ulysses_attn_fn
+
+                    self._seq_attn = make_ulysses_attn_fn(
+                        shardings.mesh, "sp", window=cfg.sliding_window
+                    )
+                else:
+                    from ..parallel.ring_attention import make_ring_attn_fn
+
+                    self._seq_attn = make_ring_attn_fn(
+                        shardings.mesh, "sp", window=cfg.sliding_window
+                    )
+                # routed buckets are powers of two >= sp (sp is a
+                # power-of-two mesh axis), so the shard split is exact
+                self.seq_prefill_min = max(self.seq_prefill_min, sp)
         if shardings is not None:
             k = shardings.put_cache(k, seq_shard=self.seq_sharded)
             v = shardings.put_cache(v, seq_shard=self.seq_sharded)
@@ -874,6 +1014,26 @@ class TPUEngine:
         obs.ENGINE_JUMP_TOKENS.labels(model=name).set_function(
             engines_sum("jump_tokens")
         )
+        # long-context tier: compression + sequence-sharded prefill
+        # counters (same WeakSet-summed monotonic-engine-counter pattern)
+        obs.KV_COMPRESS_SLOTS.labels(model=name).set_function(
+            engines_sum("kv_compress_slots")
+        )
+        obs.KV_COMPRESS_PAGES_PRUNED.labels(model=name).set_function(
+            engines_sum("kv_pages_pruned")
+        )
+        obs.PREFILL_SEQ_SHARDED.labels(model=name).set_function(
+            engines_sum("prefill_seq_sharded")
+        )
+
+        def compressed_resident() -> float:
+            return float(sum(
+                e.compressed_resident_pages() for e in engines
+            ))
+
+        obs.KV_COMPRESS_RESIDENT.labels(model=name).set_function(
+            compressed_resident
+        )
         # spec counters carry the (model, proposer) label pair — one
         # series per proposer in the closed spec.SPEC_PROPOSERS enum,
         # each summing its per-proposer engine counter over the WeakSet
@@ -962,6 +1122,27 @@ class TPUEngine:
 
     # -- jitted cores -------------------------------------------------------
 
+    def _tables_operand(self):
+        """The per-dispatch paged operand: the page tables, paired with
+        the per-slot live-window starts when window+sink KV compression
+        is armed (the mask operand rides BESIDE the tables rather than in
+        the donated state — it changes only at prune events, exactly like
+        the tables change only at alloc events). Caller holds the engine
+        lock."""
+        t = jnp.asarray(self.allocator.tables)
+        if self.kv_compress_armed:
+            return (t, jnp.asarray(self._win_starts))
+        return t
+
+    @staticmethod
+    def _split_tables(tables):
+        """Unpack a ``_tables_operand`` value into (tables, win_starts);
+        win_starts is None on engines without compression armed (their
+        graphs are byte-identical to the pre-compression tree)."""
+        if isinstance(tables, (tuple, list)):
+            return tables[0], tables[1]
+        return tables, None
+
     def _decode_body(self, params, st: DecodeState, sub, tables=None,
                      mask=None):
         """ONE decode step against whichever cache layout this engine runs
@@ -972,6 +1153,7 @@ class TPUEngine:
         ``mask`` [S, V] fp32 adds to the logits before sampling — the
         grammar-constraint hook (engine/jsonmode.py), step_masked only."""
         if self.paged:
+            tables, win_starts = self._split_tables(tables)
             scales = (
                 (st["k_s"], st["v_s"]) if self.quant_cache else None
             )
@@ -989,6 +1171,8 @@ class TPUEngine:
                 moe_impl=self._moe_impl,
                 qmm=self._qmm_impl,
                 pool_impl=self._pool_impl,
+                win_starts=win_starts,
+                sink_rows=self._sink_rows,
             )
             if self.quant_cache:
                 logits, k, v, (k_s, v_s) = out
@@ -1123,10 +1307,12 @@ class TPUEngine:
         scales = (st["k_s"], st["v_s"]) if self.quant_cache else None
         moe_impl = self._verify_moe_impl(feed.shape[1])
         if self.paged:
+            tables, win_starts = self._split_tables(tables)
             out = model.verify_step_paged(
                 params, self.cfg, feed, st["lengths"], st["k"], st["v"],
                 tables, cache_scales=scales, active=st["active"],
                 moe_impl=moe_impl, qmm=self._qmm_gspmd,
+                win_starts=win_starts, sink_rows=self._sink_rows,
             )
         else:
             out = model.verify_step(
@@ -1153,10 +1339,15 @@ class TPUEngine:
         so this is a strict generalization of ``_step_impl``."""
         S, C, K = self.num_slots, self.max_context, draft_len
         slots = jnp.arange(S)
+        # window+sink KV compression guard: a pruned slot proposes only
+        # from matches inside its LIVE trailing window (never from the
+        # pruned middle the verify attention can no longer see)
+        _, win_starts = self._split_tables(tables)
 
         def one(st, _):
             drafts, _num = spec.propose_ngram(
-                st["history"], st["lengths"], K, ngram, C
+                st["history"], st["lengths"], K, ngram, C,
+                min_pos=win_starts,
             )
             # only greedy, active slots speculate; everyone else verifies
             # a row of -1 drafts (accept count 0 => plain decode step)
@@ -1306,6 +1497,12 @@ class TPUEngine:
         (tokens [R, S, K+1], counts [R, S], proposed [R, S]))."""
         S, C, K = self.num_slots, self.max_context, draft_len
         slots = jnp.arange(S)
+        # window+sink KV compression guard: the draft's dense KV mirrors
+        # the FULL history, but a pruned slot's serving attention no
+        # longer sees the middle — the draft would propose from context
+        # the verify can't read, so pruned slots fall back to the plain
+        # step inside the round (ok gate below)
+        _, win_starts = self._split_tables(tables)
 
         def one(carry, _):
             st, dst = carry
@@ -1328,6 +1525,8 @@ class TPUEngine:
                 & (dst["lengths"] == st["lengths"])
                 & (st["lengths"] + K <= C - 2)
             )
+            if win_starts is not None:
+                ok = ok & (win_starts == 0)
             drafts, dst = self._draft_propose_body(
                 dparams, dst, st["last_tokens"], ok, K
             )
@@ -1447,15 +1646,19 @@ class TPUEngine:
 
     def _prefill_impl_paged(
         self, params, state: DecodeState, tokens, slot, true_len, temp, top_p,
-        table_row,
+        table_row, attn_fn=None,
     ):
         """Paged twin of ``_prefill_impl``: the prompt's K/V rows scatter
         into the page pool through ``table_row`` (the slot's block->page
         map; rows in unbacked blocks land on the sacrificial page 0 and are
-        never read)."""
+        never read). ``attn_fn`` (a closure, not an operand) swaps the
+        forward's attention — the sequence-sharded prefill graphs pass the
+        ring/Ulysses adapter so a huge prompt's forward spreads over the
+        mesh's sp axis while the scatter/sample/activate tail stays
+        byte-for-byte the normal admission path."""
         logits, ks, vs = model.prefill(
             params, self.cfg, tokens, kernels=self._kernels,
-            qmm=self._qmm_gspmd,
+            qmm=self._qmm_gspmd, attn_fn=attn_fn,
         )
         T = tokens.shape[1]
         P = state["k"].shape[2]
@@ -1570,17 +1773,19 @@ class TPUEngine:
         return out, first
 
     def _chunk_forward(self, params, state: DecodeState, tokens, slot, start,
-                       table_row):
+                       table_row, win_start=None):
         """One prefill chunk against whichever cache layout this engine
         runs (paged / int8 KV / dense); returns (logits, kv-state updates).
         The single place the layout dispatch lives — both chunk impls
-        build on it."""
+        build on it. ``win_start`` (armed engines only) masks the pruned
+        middle of a mid-admission compressed slot."""
         upd: Dict[str, jnp.ndarray] = {}
         if self.paged:
             scales = (state["k_s"], state["v_s"]) if self.quant_cache else None
             out = model.prefill_chunk_paged(
                 params, self.cfg, tokens, start, state["k"], state["v"],
                 table_row, cache_scales=scales, qmm=self._qmm_gspmd,
+                win_start=win_start, sink_rows=self._sink_rows,
             )
             if self.quant_cache:
                 logits, upd["k"], upd["v"], (upd["k_s"], upd["v_s"]) = out
@@ -1599,13 +1804,14 @@ class TPUEngine:
         return logits, upd
 
     def _prefill_chunk_impl(
-        self, params, state: DecodeState, tokens, slot, start, table_row=None
+        self, params, state: DecodeState, tokens, slot, start, table_row=None,
+        win_start=None,
     ):
         """Mid-prompt chunk: write K/V rows [start, start+Tc), no sampling.
         Paged engines route the writes through ``table_row`` (the slot's
         block->page map) instead of the slot index."""
         _, upd = self._chunk_forward(params, state, tokens, slot, start,
-                                     table_row)
+                                     table_row, win_start)
         new = dict(state)
         new.update(upd)
         new["history"] = self._chunk_history(state, tokens, slot, start)
@@ -1624,12 +1830,12 @@ class TPUEngine:
 
     def _final_chunk_impl(
         self, params, state: DecodeState, tokens, slot, start, n_valid,
-        true_len, temp, top_p, table_row=None,
+        true_len, temp, top_p, table_row=None, win_start=None,
     ):
         """Last chunk: write K/V, then sample the first token from the
         logits row of the prompt's true last token and activate the slot."""
         logits, upd = self._chunk_forward(params, state, tokens, slot, start,
-                                          table_row)
+                                          table_row, win_start)
         new = dict(state)
         new.update(upd)
         key, sub = jax.random.split(state["key"])
@@ -1757,6 +1963,19 @@ class TPUEngine:
         impl = self._prefill_impl_paged if self.paged else self._prefill_impl
         return jax.jit(impl, donate_argnums=(1,))
 
+    def _make_seq_prefill_jit(self):
+        """Sequence-sharded whole-prompt prefill: ``_prefill_impl_paged``
+        with the ring/Ulysses attention closed over — the forward's
+        sequence axis shards over the mesh's sp axis, everything else
+        (pool scatter, sample, activate) is the normal paged prefill."""
+        attn = self._seq_attn
+        return jax.jit(
+            lambda p, s, t, sl, tl, tm, tp_, row: self._prefill_impl_paged(
+                p, s, t, sl, tl, tm, tp_, row, attn_fn=attn
+            ),
+            donate_argnums=(1,),
+        )
+
     def _make_chunk_jit(self, final: bool):
         impl = self._final_chunk_impl if final else self._prefill_chunk_impl
         return jax.jit(impl, donate_argnums=(1,))
@@ -1808,8 +2027,7 @@ class TPUEngine:
 
     def _step_example(self) -> tuple:
         if self.paged:
-            return (self.params, self.state,
-                    jnp.asarray(self.allocator.tables))
+            return (self.params, self.state, self._tables_operand())
         return (self.params, self.state)
 
     def compile_step_fn(self, n_steps: int) -> None:
@@ -1859,7 +2077,7 @@ class TPUEngine:
             "draft_spec", self._draft_fns, key,
             self._make_draft_spec_jit(key),
             (self.params, self.draft.params, self.state, self.draft_state)
-            + ((jnp.asarray(self.allocator.tables),) if self.paged else ()),
+            + ((self._tables_operand(),) if self.paged else ()),
         )
 
     def compile_draft_ingest_fns(self) -> None:
@@ -1893,7 +2111,7 @@ class TPUEngine:
             return
         args = [self.params, self.state]
         if self.paged:
-            args.append(jnp.asarray(self.allocator.tables))
+            args.append(self._tables_operand())
         args += [
             jnp.zeros((self.num_slots, k_bucket), jnp.int32),
             jnp.zeros((self.num_slots,), jnp.int32),
@@ -1917,6 +2135,23 @@ class TPUEngine:
             args,
         )
 
+    def compile_seq_prefill_fn(self, bucket: int) -> None:
+        """Ensure the sequence-sharded prefill graph for ``bucket`` exists
+        WITHOUT dispatching (warmup calls this for every bucket the
+        routing floor + pool can reach, keeping the flat-compile-counters
+        invariant). No-op where seq-sharded prefill is disarmed."""
+        if self._seq_attn is None or bucket in self._seq_prefill_fns:
+            return
+        args = (
+            self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
+            jnp.int32(0), jnp.int32(1), jnp.float32(0.0), jnp.float32(1.0),
+            jnp.asarray(self.allocator.tables[0]),
+        )
+        self._compile_aot(
+            "seq_prefill", self._seq_prefill_fns, bucket,
+            self._make_seq_prefill_jit(), args,
+        )
+
     def compile_chunk_fn(self, bucket: int, final: bool) -> None:
         key = (bucket, final)
         if key in self._chunk_fns:
@@ -1930,6 +2165,11 @@ class TPUEngine:
                      jnp.float32(1.0)]
         if self.paged:
             args.append(jnp.asarray(self.allocator.tables[0]))
+            if self.kv_compress_armed:
+                # armed engines' chunk graphs carry the slot's live-window
+                # start (a prompt can cross the compression threshold
+                # mid-admission)
+                args.append(jnp.int32(0))
         self._compile_aot(
             "chunk", self._chunk_fns, key, self._make_chunk_jit(final),
             tuple(args),
@@ -2015,6 +2255,15 @@ class TPUEngine:
             self._prefill_fns[bucket] = fn
         return fn
 
+    def _seq_prefill_fn(self, bucket: int):
+        fn = self._seq_prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._instrument_compile(
+                self._make_seq_prefill_jit(), "seq_prefill"
+            )
+            self._seq_prefill_fns[bucket] = fn
+        return fn
+
     def _spec_fn(self, n_rounds: int, draft_len: int, ngram: int):
         key = (n_rounds, draft_len, ngram)
         fn = self._spec_fns.get(key)
@@ -2081,12 +2330,48 @@ class TPUEngine:
             )
             pos += len(seg)
 
+    def _maybe_compress(self, slot: int, length: Optional[int] = None) -> None:
+        """Window+sink KV compression (caller holds the engine lock):
+        once ``slot``'s length exceeds the threshold, release the page
+        range between the sink pages and the trailing window back to the
+        pool and advance the slot's live-window start — the mask operand
+        every subsequent dispatch reads. Pages shared with the prefix
+        index keep their index references (and spill through the host
+        tier under pressure like any cold prefix page); only this slot's
+        references drop. Monotone: the window start never rewinds.
+        ``length`` is passed explicitly by mid-admission callers (the
+        slot is not active yet and its host length is still 0)."""
+        if not self.kv_compress_armed:
+            return
+        if length is None:
+            if not self.active[slot]:
+                return
+            L = int(self._host_lengths[slot])
+        else:
+            L = int(length)
+        if L <= self.kv_compress_after:
+            return
+        P = self.allocator.page_size
+        # last block fully below the trailing window [L - window_rows, L]
+        wb = (L - self.kv_window_pages * P) // P
+        if wb <= self.kv_sink_pages:
+            return
+        if self._win_starts[slot] == 0:
+            self.kv_compress_slots += 1
+            flightrec.RECORDER.model_event(
+                self.cfg.name, "kv_compress", slot=slot, length=L,
+            )
+        freed = self.allocator.prune_range(slot, self.kv_sink_pages, wb)
+        self.kv_pages_pruned += freed
+        self._win_starts[slot] = wb * P
+
     def _back_active_slots(self, grow_rows: int) -> None:
         """Back every active slot's next ``grow_rows`` rows BEFORE a paged
         dispatch (PoolExhausted surfaces with state untouched so the
         batcher can retire a victim and retry); windowed models first
-        return pages attention can no longer reach. Caller holds the
-        engine lock."""
+        return pages attention can no longer reach, and compression-armed
+        engines prune past-threshold slots to sink + window. Caller holds
+        the engine lock."""
         for s in range(self.num_slots):
             if self.active[s]:
                 if self.cfg.sliding_window is not None:
@@ -2095,6 +2380,7 @@ class TPUEngine:
                         int(self._host_lengths[s]),
                         self.cfg.sliding_window,
                     )
+                self._maybe_compress(s)
                 self.allocator.ensure(
                     s,
                     min(
@@ -2397,6 +2683,13 @@ class TPUEngine:
             # admission; their table entries are stale and a prefix chain
             # must start at block 0 — nothing registrable
             return
+        if int(self.allocator._pruned_hi[slot]):
+            # window+sink pruning released the middle during this
+            # admission; the sink pages are still a valid (short) chain
+            # prefix, the rest maps the sacrificial page
+            hashes = hashes[: int(self.allocator._pruned_lo[slot])]
+            if not hashes:
+                return
         pages = [int(self.allocator.tables[slot, b]) for b in range(len(hashes))]
         self.prefix_index.put(hashes, pages)
 
@@ -2489,6 +2782,11 @@ class TPUEngine:
                 raise
             return first
 
+        if self._seq_route_ok(true_len):
+            return self._seq_prefill(
+                slot, token_ids, temperature, top_p, hashes
+            )
+
         bucket = self.bucket_for(true_len)
         padded = np.zeros((1, bucket), dtype=np.int32)
         padded[0, :true_len] = token_ids
@@ -2514,6 +2812,57 @@ class TPUEngine:
             self._host_greedy[slot] = temperature < sampling.GREEDY_EPS
             self._host_lengths[slot] = true_len
             self._register_prefix(slot, token_ids, hashes)
+            return int(first)
+
+    def _seq_route_ok(self, true_len: int) -> bool:
+        """Whether a prompt of ``true_len`` rows routes through the
+        sequence-sharded prefill: the path is armed, the prompt clears
+        the routing floor, and the pool can in principle back the whole
+        prompt at once (otherwise chunked admission — which composes
+        with compression trimming — is the only admission that fits)."""
+        return (
+            self._seq_attn is not None
+            and true_len >= self.seq_prefill_min
+            and self.allocator.blocks_for(true_len)
+            <= self.allocator.capacity_blocks()
+        )
+
+    def _seq_prefill(self, slot: int, ids: List[int], temperature: float,
+                     top_p: float, hashes) -> int:
+        """Whole-prompt prefill in ONE dispatch with the sequence sharded
+        over the mesh's sp axis (parallel/ring_attention.py or
+        ulysses.py): every chip works a T/sp slice of the prompt instead
+        of one replica grinding chunks serially. The resulting KV lands
+        in the normal paged layout (the shared ``_prefill_impl_paged``
+        scatter), so decode, prefix registration, spill/restore and
+        failover are indistinguishable from a chunked admission. With
+        compression armed the slot prunes immediately after admission —
+        before prefix registration, so only the sink chain registers."""
+        true_len = len(ids)
+        bucket = self.bucket_for(true_len)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, :true_len] = ids
+        with self._lock:
+            self.allocator.ensure(slot, true_len)
+            self.state, first = self._seq_prefill_fn(bucket)(
+                self.params,
+                self.state,
+                jnp.asarray(padded),
+                jnp.int32(slot),
+                jnp.int32(true_len),
+                jnp.float32(temperature),
+                jnp.float32(top_p),
+                jnp.asarray(self.allocator.tables[slot]),
+            )
+            self.active[slot] = True
+            self._host_greedy[slot] = temperature < sampling.GREEDY_EPS
+            self._host_lengths[slot] = true_len
+            self.prefill_seq_sharded += 1
+            flightrec.RECORDER.model_event(
+                self.cfg.name, "seq_prefill", slot=slot, rows=true_len,
+            )
+            self._maybe_compress(slot)
+            self._register_prefix(slot, ids, hashes)
             return int(first)
 
     def start_chunked_prefill(
@@ -2547,6 +2896,14 @@ class TPUEngine:
         if self.prefix_index is not None:
             with self._lock:
                 matched, hashes = self._match_prefix(slot, ids)
+        if not matched and self._seq_route_ok(len(ids)):
+            # the whole mesh prefills this prompt in one dispatch; the
+            # driver keeps the ChunkedPrefill duck interface so the
+            # batcher's admission loop (and its PoolExhausted recovery)
+            # need not know which path ran
+            return _SeqShardedPrefill(
+                self, slot, ids, temperature, top_p, hashes
+            )
         return ChunkedPrefill(
             self, slot, ids, temperature, top_p, chunk,
             start_pos=matched, hashes=hashes,
@@ -2577,7 +2934,7 @@ class TPUEngine:
             tables = ()
             if self.paged:
                 self._back_active_slots(n_steps)
-                tables = (jnp.asarray(self.allocator.tables),)
+                tables = (self._tables_operand(),)
             if self.unified_step:
                 fn, _ = self._unified_fn(n_steps)
                 self.state, tokens = fn(
@@ -2633,8 +2990,7 @@ class TPUEngine:
             if self.paged:
                 self._back_active_slots(1)
                 self.state, tokens = self._masked_step_fn()(
-                    self.params, self.state,
-                    jnp.asarray(self.allocator.tables), m,
+                    self.params, self.state, self._tables_operand(), m,
                 )
             else:
                 self.state, tokens = self._masked_step_fn()(
@@ -2689,7 +3045,7 @@ class TPUEngine:
             args = ()
             if self.paged:
                 self._back_active_slots(kb + 1)
-                args = (jnp.asarray(self.allocator.tables),)
+                args = (self._tables_operand(),)
             self.state = self._jump_fn(kb)(
                 self.params, self.state, *args,
                 jnp.asarray(forced), jnp.asarray(counts),
@@ -2754,7 +3110,7 @@ class TPUEngine:
                 # worst case: full acceptance every round; unused pages
                 # recycle at release
                 self._back_active_slots(n_rounds * (draft_len + 1))
-                args = (jnp.asarray(self.allocator.tables),)
+                args = (self._tables_operand(),)
             else:
                 args = ()
             self.state, (tokens, counts) = self._spec_fn(
@@ -2818,7 +3174,7 @@ class TPUEngine:
         with self._lock:
             if self.paged:
                 self._back_active_slots(n_rounds * (draft_len + 1))
-                args = (jnp.asarray(self.allocator.tables),)
+                args = (self._tables_operand(),)
             else:
                 args = ()
             self.state, self.draft_state, (tokens, counts, proposed) = (
@@ -2886,6 +3242,7 @@ class TPUEngine:
         self._host_lengths[slot] = 0
         self._draft_host_lengths[slot] = 0
         self._host_greedy[slot] = False
+        self._win_starts[slot] = 0  # next occupant starts uncompressed
         with self._lock:
             if self.allocator is not None:
                 self.allocator.free_slot(slot)  # pages recycle instantly
@@ -2900,6 +3257,20 @@ class TPUEngine:
 
     def slot_length(self, slot: int) -> int:
         return int(self._host_lengths[slot])
+
+    def compressed_resident_pages(self) -> int:
+        """Pages currently resident for slots pruned by window+sink
+        compression (sink + trailing window + the partial block) — what
+        ``aios_tpu_kv_compress_resident_pages`` reports, and the number
+        the long-context bench compares against the uncompressed
+        footprint."""
+        if not self.kv_compress_armed or self.allocator is None:
+            return 0
+        return sum(
+            self.allocator.slot_pages_resident(s)
+            for s in range(self.num_slots)
+            if self._win_starts[s] > 0
+        )
 
     def stats(self) -> Dict[str, float]:
         """Serving counters for observability (HealthCheck details, the
@@ -2944,6 +3315,12 @@ class TPUEngine:
         if self.allocator is not None:
             out["kv_pages_in_use"] = self.allocator.pages_in_use()
             out["kv_pages_free"] = self.allocator.free_pages
+        if self.kv_compress_armed:
+            out["kv_compress_slots"] = self.kv_compress_slots
+            out["kv_compress_pages_pruned"] = self.kv_pages_pruned
+            out["kv_compress_resident_pages"] = self.compressed_resident_pages()
+        if self._seq_attn is not None:
+            out["prefill_seq_sharded"] = self.prefill_seq_sharded
         if self.prefix_index is not None:
             out["prefix_hits"] = self.prefix_index.hits
             out["prefix_misses"] = self.prefix_index.misses
@@ -3003,6 +3380,8 @@ class TPUEngine:
             self._restore_fns.clear()
             self._jump_fns.clear()
             self._draft_fns.clear()
+            self._seq_prefill_fns.clear()
+            self._seq_attn = None
             self.state = {}
             self.params = None
             self.draft = None  # DraftModel params may be pool-shared
@@ -3062,6 +3441,14 @@ class TPUEngine:
             ) > self.allocator.capacity_blocks():
                 continue  # pool can't back prompts of this bucket anyway
             self.compile_prefill_fn(bucket)
+            if (
+                self._seq_attn is not None
+                and bucket >= self.bucket_for(self.seq_prefill_min)
+            ):
+                # every bucket the routing floor can reach gets its
+                # sp-sharded twin, so a huge admission never compiles
+                # on the scheduler thread
+                self.compile_seq_prefill_fn(bucket)
         ck = self.prefill_chunk_default if prefill_chunk is None else prefill_chunk
         if ck and ck in self.buckets and self.max_context % ck == 0:
             self.compile_chunk_fn(ck, final=False)
@@ -3261,12 +3648,21 @@ class ChunkedPrefill:
                 # longer attend to free as admission advances — a 64k
                 # prompt's residency is bounded by the window, not the
                 # prompt (registration then skips the trimmed slot).
+                # Compression-armed engines prune the same way: once the
+                # admitted rows cross the threshold, the middle pages
+                # free and later chunks mask them, so a long prompt's
+                # peak residency is sink + window + one chunk.
                 if eng.cfg.sliding_window is not None:
                     eng.allocator.trim_below_window(
                         self.slot, self.pos, eng.cfg.sliding_window
                     )
+                eng._maybe_compress(self.slot, length=self.pos)
                 eng.allocator.ensure(self.slot, self.pos + n)
                 extra = (jnp.asarray(eng.allocator.tables[self.slot]),)
+                if eng.kv_compress_armed:
+                    extra += (
+                        jnp.int32(int(eng._win_starts[self.slot])),
+                    )
             if final:
                 eng.state, first = eng._chunk_fn(bucket, True)(
                     eng.params,
@@ -3297,4 +3693,38 @@ class ChunkedPrefill:
                     *extra,
                 )
         self.pos += n
+        return self.first_token
+
+
+class _SeqShardedPrefill:
+    """ChunkedPrefill-shaped driver for the sequence-sharded prefill:
+    ONE ``step()`` runs the whole sp-sharded admission dispatch
+    (engine._seq_prefill), so the batcher's incremental-admission loop —
+    including its PoolExhausted eviction/retry recovery — drives both
+    paths identically. ``pos`` moves 0 -> len(ids) in that single step,
+    which is what the flight recorder's per-chunk rows-consumed
+    accounting reads."""
+
+    def __init__(self, engine: TPUEngine, slot: int, token_ids: List[int],
+                 temperature: float, top_p: float, hashes) -> None:
+        self.engine = engine
+        self.slot = slot
+        self.ids = list(token_ids)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.hashes = hashes
+        self.pos = 0
+        self.first_token: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self.first_token is not None
+
+    def step(self) -> Optional[int]:
+        if self.done:
+            return self.first_token
+        self.first_token = self.engine._seq_prefill(
+            self.slot, self.ids, self.temperature, self.top_p, self.hashes
+        )
+        self.pos = len(self.ids)
         return self.first_token

@@ -286,6 +286,8 @@ def blockwise_cache_attention(
     abs_pos: jnp.ndarray,  # [Tc] absolute position of each query row
     window: Optional[int],
     block: int = 512,
+    live_from: Optional[jnp.ndarray] = None,  # scalar: live window start
+    sink: int = 0,  # static sink rows (window+sink KV compression)
 ) -> jnp.ndarray:
     """Chunk-vs-cache attention via an online softmax over KV blocks.
 
@@ -315,6 +317,12 @@ def blockwise_cache_attention(
         visible = cols[None, :] <= abs_pos[:, None]  # [Tc, block]
         if window is not None:
             visible = visible & (cols[None, :] > abs_pos[:, None] - window)
+        if live_from is not None:
+            # window+sink KV compression: cache rows in [sink, live_from)
+            # were pruned mid-admission; the chunk must not attend them
+            visible = visible & (
+                (cols[None, :] < sink) | (cols[None, :] >= live_from)
+            )
         s = jnp.where(visible[None, None], s, jnp.float32(-1e30))
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -485,13 +493,18 @@ def forward_full(
 
 def prefill(
     params: Params, cfg: ModelConfig, tokens: jnp.ndarray, kernels=None,
-    qmm=None,
+    qmm=None, attn_fn=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Causal forward returning (logits [B,T,V], k [L,B,T,KH,D], v [...]).
 
     The engine copies the returned K/V into the request's cache slot.
+    ``attn_fn`` swaps the attention implementation — the sequence-sharded
+    prefill path passes the ring/Ulysses adapter here so one huge
+    prompt's forward spreads over the mesh's sp axis.
     """
-    return _forward_with_kv(params, cfg, tokens, kernels=kernels, qmm=qmm)
+    return _forward_with_kv(
+        params, cfg, tokens, attn_fn=attn_fn, kernels=kernels, qmm=qmm
+    )
 
 
 def _use_kernels(kernels: Optional[bool]) -> bool:
@@ -863,6 +876,8 @@ def prefill_chunk_paged(
     table_row: jnp.ndarray,  # [MB] int32 — the slot's block->page map
     cache_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
+    win_start: Optional[jnp.ndarray] = None,  # scalar: live window start
+    sink_rows: int = 0,  # static sink rows (window+sink KV compression)
 ):
     """One chunk of an incremental prefill against the PAGED cache.
 
@@ -936,6 +951,8 @@ def prefill_chunk_paged(
             positions[0],
             cfg.sliding_window,
             kv_tile,
+            live_from=win_start,
+            sink=sink_rows,
         )
         x = x + matmul(attn.reshape(B, Tc, -1), lp["wo"], qmm, "row")
         x = x + _mlp(x, lp, cfg, qmm=qmm)
@@ -971,6 +988,8 @@ def decode_step_paged(
     moe_impl: Optional[str] = None,
     qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
     pool_impl=None,  # per-device pool write+attend; see ShardingPlan
+    win_starts: Optional[jnp.ndarray] = None,  # [B] int32 live-window start
+    sink_rows: int = 0,  # static sink rows (window+sink KV compression)
 ):
     """One batched decode step over the PAGED slot cache.
 
@@ -989,10 +1008,22 @@ def decode_step_paged(
     (AIOS_TPU_INT8_RAGGED=1, ops.paged_decode_attention_int8) or
     dequantizes a gathered per-slot view on the XLA path. Returns
     (logits [B, V] fp32, k_pool', v_pool'[, (k_scales', v_scales')]).
+
+    ``win_starts``/``sink_rows`` (window+sink KV compression,
+    docs/ENGINE_PERF.md "Long-context tier"): slot b attends only rows
+    < sink_rows or >= win_starts[b]; its pruned middle pages were
+    released back to the pool and the stale table entries map the
+    sacrificial page. win_starts[b] = 0 makes the mask a no-op.
+    Unsupported with ``pool_impl`` (the dp-replicated shard_map twin —
+    the engine never arms compression there).
     """
     B = tokens.shape[0]
     P = k_pool.shape[2]
     quant_pool = cache_scales is not None
+    if win_starts is not None and pool_impl is not None:
+        raise ValueError(
+            "window+sink KV compression has no dp-replicated pool twin"
+        )
     use_kernel = _use_kernels(kernels) and not quant_pool
     # int8 pool through the paged kernel (same env gate as the dense int8
     # ragged kernel): pages stream as int8 with scales folded into the dots
@@ -1012,6 +1043,10 @@ def decode_step_paged(
         act, jnp.take_along_axis(tables, blk[:, None], axis=1)[:, 0], 0
     )
     offs = jnp.where(act, write_pages_of % P, P - 1)
+    if win_starts is not None:
+        # inactive slots read zero rows; a stale window start must not
+        # survive into their (ignored) mask either
+        win_starts = jnp.where(act, win_starts, 0)
 
     x = params["embed"][tokens][:, None, :]  # [B, 1, E]
     cos, sin = rope_tables(lengths[:, None], cfg.head_dim, cfg.rope_theta)
@@ -1036,6 +1071,7 @@ def decode_step_paged(
                 q[:, 0], k_l, v_l, k_s, v_s, tables, read_lengths,
                 window=cfg.sliding_window,
                 use_int8_kernel=use_int8_kernel,
+                win_starts=win_starts, sink=sink_rows,
             )[:, None]
         elif pool_impl is not None:
             attn, k_l, v_l = pool_impl(
@@ -1050,11 +1086,14 @@ def decode_step_paged(
                 attn = ops.paged_decode_attention(
                     q[:, 0], k_l, v_l, tables, read_lengths,
                     window=cfg.sliding_window,
+                    win_starts=win_starts,
+                    sink=sink_rows if win_starts is not None else None,
                 )[:, None]
             else:
                 attn = ops.paged_decode_attention_reference(
                     q[:, 0], k_l, v_l, tables, read_lengths,
                     window=cfg.sliding_window,
+                    win_starts=win_starts, sink=sink_rows,
                 )[:, None]
         x = x + matmul(attn.reshape(B, 1, -1), lp["wo"], qmm, "row")
         x = x + _mlp(x, lp, cfg, moe_impl, qmm)
@@ -1088,6 +1127,8 @@ def verify_step_paged(
     active: Optional[jnp.ndarray] = None,  # [B] bool
     moe_impl: Optional[str] = None,
     qmm=None,  # int4 matmul impl (x, leaf, kind) -> y; see matmul()
+    win_starts: Optional[jnp.ndarray] = None,  # [B] int32 live-window start
+    sink_rows: int = 0,  # static sink rows (window+sink KV compression)
 ):
     """``verify_step`` over the PAGED cache: the T in-flight rows scatter
     through the page tables (inactive slots -> sacrificial page 0), and
@@ -1119,6 +1160,14 @@ def verify_step_paged(
     mask = cols <= qpos[..., None]  # [B, T, C]
     if cfg.sliding_window is not None:
         mask = mask & (cols > (qpos[..., None] - cfg.sliding_window))
+    if win_starts is not None:
+        # window+sink KV compression: the pruned middle [sink, win_start)
+        # must not score — the verify rows themselves always land past
+        # the live window start (they extend the trailing window)
+        ws = jnp.where(active, win_starts, 0)
+        mask = mask & (
+            (cols < sink_rows) | (cols >= ws[:, None, None])
+        )
 
     x = params["embed"][tokens]  # [B, T, E]
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
@@ -1469,22 +1518,27 @@ def init_kv_scales(
 
 
 def paged_int8_attend(q, k_l, v_l, k_s, v_s, tables, lengths, *, window,
-                      use_int8_kernel):
+                      use_int8_kernel, win_starts=None, sink=0):
     """Decode attention over an int8 page pool for ONE layer ([B,H,D] ->
     [B,H,D]): the kernel path streams int8 pages with scales folded into
     the dots; the XLA path dequantizes a gathered per-slot view. The single
     source of truth for the int8-pool read — decode_step_paged AND the
     dp-replicated shard_map body (sharding.paged_pool_impl) both call it,
-    so mask/window semantics cannot drift between them."""
+    so mask/window semantics cannot drift between them.
+    ``win_starts``/``sink`` apply the window+sink compressed mask."""
     if use_int8_kernel:
         return ops.paged_decode_attention_int8(
-            q, k_l, v_l, k_s, v_s, tables, lengths, window=window
+            q, k_l, v_l, k_s, v_s, tables, lengths, window=window,
+            win_starts=win_starts,
+            sink=sink if win_starts is not None else None,
         )
     C = tables.shape[1] * k_l.shape[1]
     cols = jnp.arange(C)[None, :]
     mask = cols <= lengths[:, None]
     if window is not None:
         mask = mask & (cols > (lengths[:, None] - window))
+    if win_starts is not None:
+        mask = mask & ((cols < sink) | (cols >= win_starts[:, None]))
     return gqa_attention(
         q[:, None],
         gather_dequant(k_l, k_s, tables, q.dtype),

@@ -100,6 +100,13 @@ class PageAllocator:
         # leading blocks already released by sliding-window trimming; their
         # table entries are stale-but-unread until the slot frees
         self._trimmed = np.zeros(num_slots, dtype=np.int64)
+        # window+sink KV compression (prune_range): blocks
+        # [_pruned_lo, _pruned_hi) of a slot were released mid-sequence —
+        # their table entries map the sacrificial page and free_slot must
+        # not decref them again. _pruned_lo is the sink boundary (fixed
+        # once pruning starts), _pruned_hi only moves forward.
+        self._pruned_lo = np.zeros(num_slots, dtype=np.int64)
+        self._pruned_hi = np.zeros(num_slots, dtype=np.int64)
         # pages mapped by more than one owner (prefix sharing) carry a
         # refcount; rc 0 means free
         self._rc = np.zeros((replicas, self.local_pages), dtype=np.int64)
@@ -224,16 +231,23 @@ class PageAllocator:
     def free_slot(self, slot: int) -> None:
         """Drop the slot's reference on each of its pages; pages whose
         refcount hits zero return to the free list (shared prefix pages
-        survive under their other owners / the prefix index)."""
+        survive under their other owners / the prefix index). Blocks
+        released earlier by window trimming or window+sink pruning were
+        already decref'd and are skipped."""
         used = int(self._blocks_used[slot])
         r = self.replica_of(slot)
+        plo, phi = int(self._pruned_lo[slot]), int(self._pruned_hi[slot])
         for b in range(self._trimmed[slot], used):
+            if plo <= b < phi:
+                continue  # pruned: reference already dropped
             self.decref(int(self.tables[slot, b]), r)
-        # trimmed entries were already decref'd — just restore the
+        # trimmed/pruned entries were already decref'd — just restore the
         # "unbacked maps page 0" invariant for the whole row
         self.tables[slot, :used] = SACRIFICIAL_PAGE
         self._blocks_used[slot] = 0
         self._trimmed[slot] = 0
+        self._pruned_lo[slot] = 0
+        self._pruned_hi[slot] = 0
 
     def trim_below_window(self, slot: int, length: int, window: int) -> int:
         """Release the slot's leading blocks that sliding-window attention
@@ -254,6 +268,50 @@ class PageAllocator:
         if dead > self._trimmed[slot]:
             self._trimmed[slot] = dead
         return freed
+
+    def prune_range(self, slot: int, lo: int, hi: int) -> int:
+        """Window+sink KV compression: release the slot's logical blocks
+        [lo, hi) — the dead middle between the attention-sink pages
+        ([0, lo)) and the sliding window's tail. Each released page drops
+        this slot's reference (pages shared with the prefix index or
+        other slots survive under their other owners) and its table entry
+        is remapped to the sacrificial page, so a stale read is
+        deterministic garbage the pruned attention mask never exposes.
+        The range only grows forward: repeated calls release
+        [max(lo, previous hi), hi). Returns blocks released now.
+        Caller (the engine, under its lock) guarantees the mask stops
+        attending these rows before the next dispatch."""
+        used = int(self._blocks_used[slot])
+        hi = min(hi, used)
+        prev_hi = int(self._pruned_hi[slot])
+        start = max(lo, prev_hi)
+        if hi <= start:
+            return 0
+        r = self.replica_of(slot)
+        freed = 0
+        for b in range(start, hi):
+            self.decref(int(self.tables[slot, b]), r)
+            self.tables[slot, b] = SACRIFICIAL_PAGE
+            freed += 1
+        if prev_hi == 0:
+            self._pruned_lo[slot] = lo
+        self._pruned_hi[slot] = hi
+        return freed
+
+    def pruned_blocks(self, slot: int) -> int:
+        """Blocks of ``slot`` released by :meth:`prune_range` so far."""
+        return int(self._pruned_hi[slot] - self._pruned_lo[slot]) \
+            if self._pruned_hi[slot] else 0
+
+    def slot_pages_resident(self, slot: int) -> int:
+        """Pages the slot currently references (mapped blocks minus
+        window-trimmed and pruned ones) — what the compressed-slot
+        residency gauge reports."""
+        return max(
+            int(self._blocks_used[slot]) - int(self._trimmed[slot])
+            - self.pruned_blocks(slot),
+            0,
+        )
 
     def slot_rows_backed(self, slot: int) -> int:
         return int(self._blocks_used[slot]) * self.page_size

@@ -63,6 +63,7 @@ def propose_ngram(
     draft_len: int,
     ngram: int,
     max_context: int,
+    min_pos: Optional[jnp.ndarray] = None,  # [S] int32 search floor
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Propose up to ``draft_len`` tokens per slot by prompt lookup.
 
@@ -70,6 +71,13 @@ def propose_ngram(
     tokens (ending at the pending last token, history col ``lengths``) and
     proposes the tokens that followed it. Vectorized over slots and match
     positions — one fused compare/reduce, no host involvement.
+
+    ``min_pos`` clamps the match search to positions >= min_pos[s] — the
+    window+sink KV compression guard: a pruned slot's proposals must come
+    from its LIVE trailing window (the engine passes the slot's window
+    start), never from context the serving attention can no longer read,
+    or acceptance would be judged against evidence the model doesn't see.
+    min_pos[s] = 0 (or None) leaves the search unrestricted.
 
     Returns (drafts [S, draft_len] int32 with -1 beyond each slot's count,
     num_drafts [S] int32). The count is clamped so the verify step's
@@ -97,6 +105,11 @@ def propose_ngram(
     valid = p[None, :] <= (last - n)[:, None]
     # ...and exist at all (need n+1 known tokens: the pattern plus history)
     valid = valid & (last[:, None] >= n)
+    if min_pos is not None:
+        # live-rows clamp (window+sink KV compression): the whole match
+        # window — and therefore its continuation — starts at or past the
+        # slot's live window start
+        valid = valid & (p[None, :] >= min_pos[:, None])
     hit = match & valid
     # Prefer the most recent occurrence that still has a FULL draft's worth
     # of known continuation after it; fall back to the most recent partial

@@ -62,11 +62,14 @@ log = logging.getLogger("aios.obs")
 # finished tracing span, "respawn" a replica crash-respawn (model lane),
 # "failover" an in-flight re-route to a surviving replica after a crash
 # (serving/failover.py), "fault" an injected fault firing (model lane,
-# aios_tpu/faults/).
+# aios_tpu/faults/), "kv_compress" a slot crossing the window+sink
+# compression threshold and "seq_prefill" a sequence-sharded whole-mesh
+# prefill admission (model lane, docs/ENGINE_PERF.md "Long-context
+# tier").
 EVENT_KINDS = (
     "admit", "shed", "route", "queue", "prefill", "decode", "jump",
     "spec", "restore", "spill", "retire", "abort", "cancel", "span",
-    "respawn", "failover", "fault",
+    "respawn", "failover", "fault", "kv_compress", "seq_prefill",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these

@@ -199,6 +199,38 @@ SPEC_ACCEPTANCE = Gauge(
     ("model", "proposer"),
 )
 
+# -- long-context tier (docs/ENGINE_PERF.md "Long-context tier") -----------
+# Window+sink KV compression + sequence-sharded prefill. Counters are
+# monotonic engine counters read at scrape time, SUMMED over the
+# per-model WeakSet of live replica engines (the jump/spec pattern);
+# the resident gauge reads live allocator state.
+
+KV_COMPRESS_SLOTS = Gauge(
+    "aios_tpu_kv_compress_slots_total",
+    "Slots whose KV crossed the compression threshold and pruned to "
+    "sink + window pages (monotonic, summed over replica engines)",
+    ("model",),
+)
+KV_COMPRESS_PAGES_PRUNED = Gauge(
+    "aios_tpu_kv_compress_pages_pruned_total",
+    "KV pages released back to the pool by window+sink pruning "
+    "(monotonic, summed over replica engines)",
+    ("model",),
+)
+KV_COMPRESS_RESIDENT = Gauge(
+    "aios_tpu_kv_compress_resident_pages",
+    "Pages currently resident for compressed slots (sink + trailing "
+    "window + partial block; scrape-time, summed over replica engines)",
+    ("model",),
+)
+PREFILL_SEQ_SHARDED = Gauge(
+    "aios_tpu_prefill_seq_sharded_total",
+    "Prompts admitted through the sequence-sharded (sp-axis ring/"
+    "Ulysses) prefill path instead of chunked admission (monotonic, "
+    "summed over replica engines)",
+    ("model",),
+)
+
 # -- prefix-cache host spill tier (engine/paged.py HostPageStore) ----------
 # Monotonic store counters surface as count-valued gauges read at scrape
 # time (the ENGINE_PREFIX_* pattern); only the restore latency is a true

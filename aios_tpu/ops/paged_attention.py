@@ -33,18 +33,28 @@ NEG_INF = -1e30
 def _paged_decode_kernel(
     len_ref,  # SMEM [B] int32
     tbl_ref,  # SMEM [B, MB] int32 — logical block -> physical page
-    q_ref,  # VMEM [1, H, D]
-    k_pool,  # ANY  [N, P, KH*D]  (bf16, or int8 when quantized)
-    v_pool,  # ANY  [N, P, KH*D]
-    *rest,  # quantized: ks_pool [N, KH, P] f32 (head-major — the lane dim
-    #         must be the 128-aligned page axis), vs_pool, o_ref; else o_ref
+    *args,  # [ws_ref SMEM [B] when sink is not None,] q_ref, k_pool,
+    #         v_pool, then quantized: ks_pool [N, KH, P] f32 (head-major —
+    #         the lane dim must be the 128-aligned page axis), vs_pool,
+    #         o_ref; else o_ref
     num_kv_heads: int,
     head_dim: int,
     page_size: int,
     window: Optional[int],
+    sink: Optional[int],
     sm_scale: float,
     quantized: bool = False,
 ):
+    # window+sink KV compression (docs/ENGINE_PERF.md "Long-context
+    # tier"): ws_ref[b] is where slot b's live trailing window begins —
+    # rows in [sink, ws_ref[b]) were pruned from the pool and their table
+    # entries remap the sacrificial page, so they must score as invalid.
+    # ws = 0 makes the extra mask a no-op (uncompressed slot).
+    if sink is not None:
+        ws_ref, q_ref, k_pool, v_pool, *rest = args
+    else:
+        ws_ref = None
+        q_ref, k_pool, v_pool, *rest = args
     if quantized:
         ks_pool, vs_pool, o_ref = rest
     else:
@@ -110,6 +120,11 @@ def _paged_decode_kernel(
             valid = cols <= length
             if window is not None:
                 valid = jnp.logical_and(valid, cols > length - window)
+            if sink is not None:
+                valid = jnp.logical_and(
+                    valid,
+                    jnp.logical_or(cols < sink, cols >= ws_ref[b]),
+                )
 
             parts = []
             for h in range(KH):
@@ -184,11 +199,14 @@ def _paged_decode_kernel(
 
 
 def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
-                interpret):
+                win_starts, sink, interpret):
     """Shared pallas_call plumbing for both pool dtypes."""
     B, H, D = q.shape
     N, P, KH = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
     quantized = scales is not None
+    compressed = win_starts is not None
+    if compressed and sink is None:
+        raise ValueError("win_starts needs a static sink row count")
     if quantized and P % 128 and not interpret:
         # Same Mosaic lane constraint as the ragged int8 kernel
         # (decode_attention.py): the scale transpose below puts the page
@@ -203,6 +221,7 @@ def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
         head_dim=D,
         page_size=P,
         window=window,
+        sink=sink if compressed else None,
         sm_scale=1.0 / float(np.sqrt(D)),
         quantized=quantized,
     )
@@ -212,6 +231,12 @@ def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
     args = [
         lengths.astype(jnp.int32),
         tables.astype(jnp.int32),
+    ]
+    ws_specs = []
+    if compressed:
+        args.append(win_starts.astype(jnp.int32))
+        ws_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    args += [
         q,
         k_pool.reshape(N, P, KH * D),
         v_pool.reshape(N, P, KH * D),
@@ -227,6 +252,7 @@ def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths
             pl.BlockSpec(memory_space=pltpu.SMEM),  # page tables
+            *ws_specs,  # window starts (compressed engines only)
             pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
             *pool_specs,  # pools (+ scales) stay in HBM
         ],
@@ -235,7 +261,7 @@ def _paged_call(q, k_pool, v_pool, tables, lengths, scales, *, window,
     )(*args)
 
 
-@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+@functools.partial(jax.jit, static_argnames=("window", "sink", "interpret"))
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, H, D] — one new query per slot
     k_pool: jnp.ndarray,  # [N, P, KH, D] — shared page pool
@@ -244,16 +270,21 @@ def paged_decode_attention(
     lengths: jnp.ndarray,  # [B] int32; row `lengths[b]` is the newest token
     *,
     window: Optional[int] = None,
+    win_starts: Optional[jnp.ndarray] = None,  # [B] int32 live-window start
+    sink: Optional[int] = None,  # static sink row count (with win_starts)
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Paged ragged decode attention; returns [B, H, D]."""
+    """Paged ragged decode attention; returns [B, H, D]. With
+    ``win_starts``/``sink`` (window+sink KV compression) slot b attends
+    only rows < sink or >= win_starts[b] — the pruned middle is masked."""
     return _paged_call(
         q, k_pool, v_pool, tables, lengths, None,
-        window=window, interpret=interpret,
+        window=window, win_starts=win_starts, sink=sink,
+        interpret=interpret,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+@functools.partial(jax.jit, static_argnames=("window", "sink", "interpret"))
 def paged_decode_attention_int8(
     q: jnp.ndarray,  # [B, H, D]
     k_pool: jnp.ndarray,  # [N, P, KH, D] int8
@@ -264,15 +295,19 @@ def paged_decode_attention_int8(
     lengths: jnp.ndarray,  # [B] int32
     *,
     window: Optional[int] = None,
+    win_starts: Optional[jnp.ndarray] = None,  # [B] int32 live-window start
+    sink: Optional[int] = None,  # static sink row count (with win_starts)
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Paged ragged decode attention over an INT8 page pool: pages stream
     as int8 (half the HBM bytes) with per-(page-row, kv-head) scales
     folded into the score/value dots — same contract as
-    decode_attention_int8 with the page-table indirection."""
+    decode_attention_int8 with the page-table indirection (and the same
+    ``win_starts``/``sink`` compressed mask as the bf16 kernel)."""
     return _paged_call(
         q, k_pool, v_pool, tables, lengths, (k_scales, v_scales),
-        window=window, interpret=interpret,
+        window=window, win_starts=win_starts, sink=sink,
+        interpret=interpret,
     )
 
 
@@ -286,12 +321,15 @@ def paged_decode_attention_int8_reference(
     lengths: jnp.ndarray,
     *,
     window: Optional[int] = None,
+    win_starts: Optional[jnp.ndarray] = None,
+    sink: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dequantize-then-attend ground truth for the int8 paged kernel."""
     kf = k_pool.astype(jnp.float32) * k_scales[..., None]
     vf = v_pool.astype(jnp.float32) * v_scales[..., None]
     return paged_decode_attention_reference(
-        q, kf, vf, tables, lengths, window=window
+        q, kf, vf, tables, lengths, window=window,
+        win_starts=win_starts, sink=sink,
     )
 
 
@@ -313,10 +351,14 @@ def paged_decode_attention_reference(
     lengths: jnp.ndarray,
     *,
     window: Optional[int] = None,
+    win_starts: Optional[jnp.ndarray] = None,  # [B] int32 live-window start
+    sink: Optional[int] = None,  # static sink row count (with win_starts)
 ) -> jnp.ndarray:
     """Naive jnp paged decode attention (CPU fallback + parity truth):
     gathers each slot's pages into a contiguous view, then does the same
-    masked attention as the dense reference."""
+    masked attention as the dense reference. ``win_starts``/``sink``
+    apply the window+sink compressed mask (rows in [sink, win_starts[b])
+    are pruned and must not score)."""
     B, H, D = q.shape
     KH = k_pool.shape[2]
     G = H // KH
@@ -330,6 +372,8 @@ def paged_decode_attention_reference(
     mask = cols <= lengths[:, None]
     if window is not None:
         mask = mask & (cols > lengths[:, None] - window)
+    if win_starts is not None:
+        mask = mask & ((cols < int(sink)) | (cols >= win_starts[:, None]))
     s = jnp.where(mask[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgc,bckd->bkgd", p, v)

@@ -669,6 +669,24 @@ class ModelManager:
                         pass
                 raise
             del params
+            # long-context tier (docs/ENGINE_PERF.md): surface what the
+            # engines armed — the knobs resolve env-over-config inside
+            # the engine, so the load log is where an operator sees the
+            # effective policy
+            if getattr(engines[0], "kv_compress_armed", False):
+                log.info(
+                    "%s: window+sink KV compression armed (threshold %d "
+                    "rows; %d sink + %d window pages/slot)", name,
+                    engines[0].kv_compress_after,
+                    engines[0].kv_sink_pages, engines[0].kv_window_pages,
+                )
+            if getattr(engines[0], "seq_prefill_min", 0):
+                log.info(
+                    "%s: sequence-sharded prefill armed (prompts >= %d "
+                    "rows spread over sp=%d)", name,
+                    engines[0].seq_prefill_min,
+                    self.plan.sp if self.plan is not None else 1,
+                )
 
             def batcher_factory(eng, _tok=tokenizer, _spec=spec_on):
                 # the pool's spawn AND crash-respawn path — a replica

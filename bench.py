@@ -55,8 +55,35 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _platform_stamp() -> dict:
+    """Which backend this process is ACTUALLY measuring. Every JSON
+    result line carries it so CPU-side A/B numbers can never be mistaken
+    for hardware numbers again (BENCH_r03–r05 benched a downed TPU
+    tunnel without saying so). Deliberately side-effect-free: if jax is
+    not imported yet (diagnostic lines before the backend probe), the
+    stamp says so instead of initializing a backend just to label an
+    error line."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        hint = os.environ.get("JAX_PLATFORMS", "")
+        return {
+            "platform": "uninitialized",
+            "device_kind": f"jax not imported (JAX_PLATFORMS={hint!r})",
+        }
+    try:
+        dev = jax.devices()[0]
+        return {
+            "platform": jax.default_backend(),
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+        }
+    except Exception as e:  # backend died mid-run: stamp the failure
+        return {"platform": "unavailable", "device_kind": repr(e)[:120]}
+
+
 def emit(obj):
-    print(json.dumps(obj), flush=True)
+    stamped = dict(_platform_stamp())
+    stamped.update(obj)  # an explicit platform in obj wins
+    print(json.dumps(stamped), flush=True)
 
 
 def slo_block(model: str) -> dict:
@@ -709,6 +736,112 @@ def bench_host_tier():
     }
 
 
+def bench_longctx(smoke: bool = False):
+    """Long-context tier A/B (window+sink KV compression, ISSUE 13):
+    admit several long prompts through chunked admission with
+    compression off vs on and report PEAK resident KV pages (sampled
+    after every admission chunk and decode dispatch) plus decode tok/s.
+    The compression win is deterministic page accounting, not wall
+    clock, so CPU fallback numbers are meaningful (the bench_host_tier
+    rationale). The prefix cache is off so pruned pages actually return
+    to the pool instead of lingering as index-held cold entries.
+    ``smoke=True`` (--longctx-smoke) runs just the compressed arm:
+    long prompt -> compression kicks in -> decode continues, exit 0."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST.scaled(name="tiny-longctx", max_context=1024)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    slots, decode_tokens = 4, 48
+    rng = np.random.default_rng(7)
+    prompts = [
+        [int(t) for t in rng.integers(1, 500, 800)] for _ in range(slots)
+    ]
+
+    def run(compress: bool):
+        kw = {}
+        if compress:
+            kw = dict(kv_compress_after=256, kv_sink_pages=1,
+                      kv_window_pages=4)
+        eng = TPUEngine(
+            cfg, params, num_slots=slots, max_context=1024,
+            cache_dtype=jnp.float32, paged_pool_rows=4096, page_size=32,
+            prefix_cache=False, **kw,
+        )
+        peak = 0
+        streams = [[] for _ in range(slots)]
+        try:
+            eng.warmup(step_sizes=(8,), prefill_chunk=128)
+            for s, ids in enumerate(prompts):
+                pc = eng.start_chunked_prefill(s, ids, chunk=128)
+                first = pc.step()
+                peak = max(peak, eng.allocator.pages_in_use())
+                while first is None:
+                    first = pc.step()
+                    peak = max(peak, eng.allocator.pages_in_use())
+                streams[s].append(first)
+            t0 = time.time()
+            done = 0
+            while done < decode_tokens:
+                toks = eng.step(8)
+                peak = max(peak, eng.allocator.pages_in_use())
+                for r in range(toks.shape[0]):
+                    for s in range(slots):
+                        streams[s].append(int(toks[r, s]))
+                done += toks.shape[0]
+            dt = time.time() - t0
+            stats = eng.stats()
+        finally:
+            eng.close()
+        tps = slots * decode_tokens / max(dt, 1e-9)
+        return peak, tps, streams, stats
+
+    if smoke:
+        peak_on, tps_on, _, stats = run(True)
+        log(f"[longctx] smoke: peak {peak_on} pages, "
+            f"{stats.get('kv_compress_pages_pruned', 0):.0f} pruned, "
+            f"{tps_on:.1f} tok/s")
+        return {
+            "metric": "long-context smoke (compression kicks in, decode "
+                      "continues)",
+            "value": float(stats.get("kv_compress_pages_pruned", 0)),
+            "unit": "pages pruned",
+            "vs_baseline": 1.0,
+            "peak_resident_pages": peak_on,
+            "compressed_slots": int(stats.get("kv_compress_slots", 0)),
+        }
+
+    peak_off, tps_off, streams_off, _ = run(False)
+    peak_on, tps_on, streams_on, stats = run(True)
+    peak_on2, _, streams_on2, _ = run(True)  # determinism across runs
+    deterministic = streams_on == streams_on2 and peak_on == peak_on2
+    ratio = peak_off / max(peak_on, 1)
+    log(f"[longctx] peak pages off {peak_off} vs on {peak_on} "
+        f"({ratio:.2f}x); tok/s off {tps_off:.1f} vs on {tps_on:.1f}; "
+        f"deterministic={deterministic}")
+    return {
+        "metric": "long-context tier: peak resident KV pages, "
+                  f"{slots} x 800-token prompts + {decode_tokens} decode "
+                  "tokens, compression off vs on (window+sink)",
+        "value": round(ratio, 2),
+        "unit": "x peak KV page reduction (off/on)",
+        "vs_baseline": round(ratio, 2),
+        "peak_pages_off": peak_off,
+        "peak_pages_on": peak_on,
+        "tok_per_s_off": round(tps_off, 1),
+        "tok_per_s_on": round(tps_on, 1),
+        "pages_pruned": int(stats.get("kv_compress_pages_pruned", 0)),
+        "compressed_slots": int(stats.get("kv_compress_slots", 0)),
+        "streams_deterministic": deterministic,
+    }
+
+
 def bench_flight_dump():
     """Flight-recorder smoke (--flight-dump): serve a greedy wave
     through a tiny 2-replica pool, then verify the full observability
@@ -819,12 +952,23 @@ def bench_chaos(seed: int = 42) -> int:
                                    dtype=jnp.float32)
     draft_model = spec_mod.DraftModel(cfg, params, quantize=None)
 
-    def run_once(with_draft: bool):
+    def run_once(with_draft: bool, longctx: bool = False):
         plan = faults.activate(schedule)
+        # the longctx arm serves a paged pool with window+sink KV
+        # compression armed and prompts LONG enough to cross the
+        # threshold mid-storm: pruning + masked decode + failover
+        # re-prefill must all stay deterministic under the same seeded
+        # fault schedule (ISSUE 13 chaos gate)
+        eng_kw = {}
+        if longctx:
+            eng_kw = dict(paged_pool_rows=512, page_size=16,
+                          prefix_cache=False, kv_compress_after=96,
+                          kv_sink_pages=1, kv_window_pages=4)
         engines = [
             TPUEngine(cfg, params, num_slots=2, max_context=256,
                       cache_dtype=jnp.float32,
-                      draft=draft_model if with_draft else None)
+                      draft=draft_model if with_draft else None,
+                      **eng_kw)
             for _ in range(2)
         ]
         pool = ReplicaPool(
@@ -837,10 +981,11 @@ def bench_chaos(seed: int = 42) -> int:
         )
         streams: dict = {}
         threads, handles = [], []
+        prompt_tail = [7, 11] * 60 if longctx else [7, 11]
         try:
             for i in range(n_req):
                 h = pool.submit(
-                    Request(prompt_ids=[3 + i, 7, 11],
+                    Request(prompt_ids=[3 + i] + prompt_tail,
                             max_tokens=max_tokens, temperature=0.0,
                             request_id=f"chaos-{i}"),
                     tenant=f"tenant-{i % 2}",
@@ -878,9 +1023,12 @@ def bench_chaos(seed: int = 42) -> int:
         }
 
     arms = {}
-    for arm, with_draft in (("plain", False), ("draft", True)):
-        a = run_once(with_draft)
-        b = run_once(with_draft)
+    for arm, with_draft, longctx in (
+        ("plain", False, False), ("draft", True, False),
+        ("longctx", False, True),
+    ):
+        a = run_once(with_draft, longctx)
+        b = run_once(with_draft, longctx)
         complete = all(
             s is not None and len(s) == max_tokens for s in a["streams"]
         )
@@ -908,16 +1056,18 @@ def bench_chaos(seed: int = 42) -> int:
     ok = (stuck == 0 and aborted == 0 and complete and deterministic
           and spec_identical)
     pa, da = arms["plain"]["a"], arms["draft"]["a"]
+    la = arms["longctx"]["a"]
     log(f"[chaos] seed={seed} restarts plain="
         f"{pa['restarts']}/{arms['plain']['b']['restarts']} draft="
-        f"{da['restarts']}/{arms['draft']['b']['restarts']} "
+        f"{da['restarts']}/{arms['draft']['b']['restarts']} longctx="
+        f"{la['restarts']}/{arms['longctx']['b']['restarts']} "
         f"stuck={stuck} aborted={aborted} deterministic={deterministic} "
         f"draft_streams_match={spec_identical} "
         f"verdict={'PASS' if ok else 'FAIL'}")
     emit({
         "metric": "chaos storm (seeded crash + dispatch delay, "
-                  "2-replica pool, plain + draft-speculation arms, "
-                  "each run twice)",
+                  "2-replica pool, plain + draft-speculation + "
+                  "longctx-compression arms, each run twice)",
         "value": 1.0 if ok else 0.0,
         "unit": "verdict (1 = pass)",
         "vs_baseline": 1.0 if ok else 0.0,
@@ -927,7 +1077,7 @@ def bench_chaos(seed: int = 42) -> int:
         "stuck": stuck,
         "aborted": aborted,
         "availability": round(
-            1.0 - aborted / (4.0 * n_req), 4
+            1.0 - aborted / (2.0 * len(arms) * n_req), 4
         ),
         "replica_restarts": {
             arm: [v["a"]["restarts"], v["b"]["restarts"]]
@@ -1721,6 +1871,11 @@ def main() -> int:
                          "spill->restore exercise (assertion-free, CPU "
                          "fallback fine, always exit 0) — the cheap "
                          "regression probe for the host spill tier")
+    ap.add_argument("--longctx-smoke", action="store_true",
+                    help="run ONLY the long-context probe: a long prompt "
+                         "admits chunked, window+sink KV compression "
+                         "kicks in, decode continues (assertion-free, "
+                         "CPU fallback fine, always exit 0)")
     ap.add_argument("--flight-dump", action="store_true",
                     help="run ONLY the flight-recorder smoke: a tiny "
                          "2-replica pool wave whose request timelines "
@@ -1766,6 +1921,17 @@ def main() -> int:
             log(f"[host-tier] FAILED: {e!r}")
             emit({"metric": "prefix-cache host tier spill->restore "
                             "(tiny geometry, restore vs recompute prefill)",
+                  "value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
+                  "error": repr(e)[:300]})
+        return 0
+
+    if args.longctx_smoke:
+        try:
+            emit(bench_longctx(smoke=True))
+        except Exception as e:  # assertion-free: diagnose, never fail
+            log(f"[longctx] FAILED: {e!r}")
+            emit({"metric": "long-context smoke (compression kicks in, "
+                            "decode continues)",
                   "value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
                   "error": repr(e)[:300]})
         return 0
@@ -1824,8 +1990,8 @@ def main() -> int:
         configs = configs[:1]
     extra = [] if args.skip_mistral else [bench_mixed_tier, bench_spec_decode]
     extra.extend([
-        bench_paged_kv, bench_host_tier, bench_dispatch, bench_structured,
-        bench_draft, bench_agent_ttft, bench_moe_gather,
+        bench_paged_kv, bench_host_tier, bench_longctx, bench_dispatch,
+        bench_structured, bench_draft, bench_agent_ttft, bench_moe_gather,
         bench_int8_kv_ragged_ab, bench_orchestrator_e2e,
     ])
     if args.fast:

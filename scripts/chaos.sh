@@ -5,15 +5,18 @@
 #
 # Runs bench.py --chaos: the SAME seeded fault schedule (replica
 # scheduler crash + probabilistic dispatch delays) against fresh pools
-# under a concurrent greedy wave — TWO ARMS (a plain pool and a
+# under a concurrent greedy wave — THREE ARMS (a plain pool, a
 # draft-speculation pool with a paired DraftModel + speculative
-# batchers), each run twice. Exit is NON-ZERO on any stuck request, any
-# aborted stream (transparent failover must complete every greedy
-# request), a nondeterministic re-run (token streams, terminal states,
-# and the nth-mode injected-fault sequence must be identical), or a
-# draft-arm stream that diverges from the plain arm's (speculation may
-# change dispatch counts, never tokens — even across a mid-storm crash
-# and the failover-time draft-KV rebuild).
+# batchers, and a longctx pool with window+sink KV compression armed
+# and prompts long enough to prune mid-storm), each run twice. Exit is
+# NON-ZERO on any stuck request, any aborted stream (transparent
+# failover must complete every greedy request), a nondeterministic
+# re-run (token streams, terminal states, and the nth-mode
+# injected-fault sequence must be identical — including the compressed
+# arm's pruned streams), or a draft-arm stream that diverges from the
+# plain arm's (speculation may change dispatch counts, never tokens —
+# even across a mid-storm crash and the failover-time draft-KV
+# rebuild).
 #
 # Usage:
 #   scripts/chaos.sh                 # default seed (42)

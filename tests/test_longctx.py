@@ -1,0 +1,437 @@
+"""Long-context tier (ISSUE 13): window+sink KV compression and
+sequence-sharded prefill.
+
+Covers the acceptance contract end to end:
+  * token identity below the threshold — compression armed but never
+    triggered is byte-identical to a plain paged engine;
+  * page-accounting invariants under pruning — no page simultaneously
+    free-listed and mapped by a live table position, pruned pages return
+    to the pool, free_slot never double-frees;
+  * pruned pages that the prefix index still holds spill through the
+    PR 4 host tier with a valid crc32 and restore cleanly;
+  * sequence-sharded prefill (ring attention over the sp axis) is
+    greedy token-identical to single-replica prefill on a CPU mesh, and
+    composes with compression;
+  * the speculation guard — n-gram and draft proposers never propose
+    from (or verify against) pruned positions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aios_tpu.engine import model as model_mod, spec
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.engine.paged import PageAllocator, SACRIFICIAL_PAGE
+
+CFG = TINY_TEST.scaled(name="longctx-test", max_context=512)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model_mod.init_params(CFG, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+
+
+def make_engine(params, **kw):
+    base = dict(
+        num_slots=2, max_context=512, cache_dtype=jnp.float32,
+        paged_pool_rows=1024, page_size=32,
+    )
+    base.update(kw)
+    return TPUEngine(CFG, params, **base)
+
+
+def prompt_of(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [int(t) for t in rng.integers(1, 500, n)]
+
+
+# -- allocator units --------------------------------------------------------
+
+
+def test_prune_range_accounting():
+    """prune_range releases the middle once, remaps the table entries to
+    the sacrificial page, grows monotonically, and free_slot neither
+    double-frees pruned blocks nor leaks the survivors."""
+    alloc = PageAllocator(num_pages=32, page_size=16, num_slots=2,
+                          max_blocks=16)
+    alloc.ensure(0, 10 * 16)  # 10 blocks
+    free0 = alloc.free_pages
+    freed = alloc.prune_range(0, 1, 6)  # sink block 0, window from 6
+    assert freed == 5
+    assert alloc.free_pages == free0 + 5
+    assert alloc.pruned_blocks(0) == 5
+    assert all(
+        int(alloc.tables[0, b]) == SACRIFICIAL_PAGE for b in range(1, 6)
+    )
+    # live positions map real pages with refcount 1, and none of them is
+    # on the free list (the no-page-both-free-and-mapped invariant)
+    free_set = set(alloc._free[0])
+    for b in list(range(0, 1)) + list(range(6, 10)):
+        page = int(alloc.tables[0, b])
+        assert page != SACRIFICIAL_PAGE
+        assert alloc.refcount(page) == 1
+        assert page not in free_set
+    # monotone: re-pruning the same range is a no-op; extending prunes
+    # only the delta
+    assert alloc.prune_range(0, 1, 6) == 0
+    assert alloc.prune_range(0, 1, 8) == 2
+    assert alloc.slot_pages_resident(0) == 10 - 7
+    # free_slot returns exactly the live pages (pruned ones already went)
+    alloc.free_slot(0)
+    assert alloc.free_pages == 31  # every non-sacrificial page is free
+    assert alloc.pruned_blocks(0) == 0
+
+
+def test_prune_shared_page_survives_under_index_reference():
+    """A pruned block whose page the prefix index still references keeps
+    the page resident (refcount drops by one, never to zero)."""
+    alloc = PageAllocator(num_pages=16, page_size=16, num_slots=1,
+                          max_blocks=8)
+    alloc.ensure(0, 4 * 16)
+    shared = int(alloc.tables[0, 1])
+    alloc.incref(shared)  # the index's reference
+    free0 = alloc.free_pages
+    alloc.prune_range(0, 1, 3)
+    # block 2's page freed; block 1's page survives at refcount 1
+    assert alloc.refcount(shared) == 1
+    assert alloc.free_pages == free0 + 1
+    alloc.decref(shared)
+    assert alloc.free_pages == free0 + 2
+
+
+# -- token identity below threshold ----------------------------------------
+
+
+def test_below_threshold_token_identity(params):
+    """Armed-but-untriggered compression is byte-identical to the plain
+    paged engine: the win_starts operand stays 0, the mask is the
+    identity, and nothing prunes."""
+    plain = make_engine(params)
+    armed = make_engine(params, kv_compress_after=320, kv_sink_pages=1,
+                        kv_window_pages=4)
+    assert armed.kv_compress_armed
+    try:
+        ids = prompt_of(100, seed=3)
+        out_plain = plain.generate(ids, max_new_tokens=24, temperature=0.0)
+        out_armed = armed.generate(ids, max_new_tokens=24, temperature=0.0)
+        assert out_plain == out_armed
+        assert armed.kv_pages_pruned == 0
+        assert armed.kv_compress_slots == 0
+        assert int(armed._win_starts.sum()) == 0
+    finally:
+        plain.close()
+        armed.close()
+
+
+# -- pruning under decode ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def armed_engine(params):
+    # prefix cache off: above the threshold a prefix-hit readmission
+    # takes the chunked path, whose mid-admission pruning is a different
+    # (deterministic) attention schedule than the cold whole-prompt
+    # prefill — each PATH repeats exactly, which is the contract
+    # (docs/ENGINE_PERF.md "Long-context tier", determinism note)
+    eng = make_engine(params, kv_compress_after=256, kv_sink_pages=1,
+                      kv_window_pages=4, prefix_cache=False)
+    yield eng
+    eng.close()
+
+
+def test_long_decode_prunes_and_stays_deterministic(armed_engine):
+    """A slot crossing the threshold prunes to sink + window, decode
+    continues, streams repeat exactly, and the page accounting holds."""
+    eng = armed_engine
+    ids = prompt_of(300, seed=4)
+    out1 = eng.generate(ids, max_new_tokens=48, temperature=0.0)
+    pruned1 = eng.kv_pages_pruned
+    assert pruned1 > 0
+    assert eng.kv_compress_slots >= 1
+    out2 = eng.generate(ids, max_new_tokens=48, temperature=0.0)
+    assert out1 == out2
+    # all pages returned after release (prefix-index-held pages aside)
+    alloc = eng.allocator
+    mapped = {
+        int(alloc.tables[s, b])
+        for s in range(eng.num_slots)
+        for b in range(int(alloc._blocks_used[s]))
+    } - {SACRIFICIAL_PAGE}
+    free_set = set(alloc._free[0])
+    assert not (mapped & free_set), "page simultaneously free and mapped"
+
+
+def test_prune_respects_live_window_accounting(armed_engine):
+    """Mid-decode, the live window start is page-aligned, the resident
+    pages match sink + window + partial, and no live table entry is on
+    the free list."""
+    eng = armed_engine
+    ids = prompt_of(300, seed=5)
+    eng.prefill(0, ids, temperature=0.0)
+    eng.step(32)  # crosses the 256 threshold; prunes in _back_active_slots
+    alloc = eng.allocator
+    ws = int(eng._win_starts[0])
+    P = alloc.page_size
+    assert ws > 0 and ws % P == 0
+    L = eng.slot_length(0)
+    assert ws <= L - eng.kv_window_pages * P
+    resident = alloc.slot_pages_resident(0)
+    assert resident == int(alloc._blocks_used[0]) - alloc.pruned_blocks(0)
+    assert eng.compressed_resident_pages() >= resident
+    free_set = set(alloc._free[0])
+    for b in range(int(alloc._blocks_used[0])):
+        page = int(alloc.tables[0, b])
+        if page != SACRIFICIAL_PAGE:
+            assert page not in free_set
+    eng.release(0)
+    assert int(eng._win_starts[0]) == 0
+
+
+def test_chunked_admission_prunes_midflight(params):
+    """A prompt larger than the pool can back whole still admits through
+    chunked admission: pruning frees the middle as chunks land and the
+    peak residency stays near sink + window + chunk."""
+    eng = TPUEngine(
+        CFG, params, num_slots=2, max_context=512,
+        cache_dtype=jnp.float32, paged_pool_rows=320, page_size=32,
+        kv_compress_after=128, kv_sink_pages=1, kv_window_pages=2,
+    )
+    try:
+        ids = prompt_of(400, seed=6)
+        # 400 rows = 13 blocks > the 10-block capacity: only compression
+        # makes this admissible
+        assert eng.allocator.blocks_for(len(ids)) \
+            > eng.allocator.capacity_blocks()
+        pc = eng.start_chunked_prefill(0, ids, chunk=64)
+        first = pc.step()
+        while first is None:
+            first = pc.step()
+        assert int(eng._win_starts[0]) > 0
+        assert eng.kv_pages_pruned > 0
+        toks = eng.step(8)
+        assert toks.shape == (8, 2)
+        eng.release(0)
+    finally:
+        eng.close()
+
+
+# -- pruned pages spill + restore through the host tier ---------------------
+
+
+def test_pruned_pages_spill_with_valid_crc_and_restore(params):
+    """Pages pruned from a slot but still held by the prefix index spill
+    through the host tier under pool pressure (crc32 layer unchanged)
+    and restore cleanly on a later chain hit."""
+    eng = TPUEngine(
+        CFG, params, num_slots=2, max_context=512,
+        cache_dtype=jnp.float32, paged_pool_rows=1024, page_size=32,
+        prefix_host_bytes=64 << 20,
+        kv_compress_after=256, kv_sink_pages=1, kv_window_pages=4,
+    )
+    try:
+        ids = prompt_of(250, seed=7)  # below threshold: full chain registers
+        eng.prefill(0, ids, temperature=0.0)
+        # decode in chunks (the batcher's shape): pruning runs between
+        # dispatches, once the advancing length crosses the threshold
+        for _ in range(8):
+            eng.step(8)
+        assert eng.kv_pages_pruned > 0
+        eng.release(0)
+        # the pruned blocks' pages survive only under the index; force a
+        # reclaim so they spill to the host store
+        import time as _time
+
+        before = eng.host_store.spills
+        with eng._lock:
+            n = eng.prefix_index.reclaim(4)
+        assert n > 0
+        deadline = _time.time() + 10
+        while eng.host_store.spills == before and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert eng.host_store.spills > before
+        assert eng.host_store.corruptions == 0
+        # resubmit: the chain head hits HBM or the host tier; the
+        # restore path must verify crc and produce the same stream
+        out = eng.generate(ids, max_new_tokens=16, temperature=0.0)
+        assert len(out) == 16
+        assert eng.host_store.corruptions == 0
+        # invariant after the round trip
+        alloc = eng.allocator
+        free_set = set(alloc._free[0])
+        for h, page in eng.prefix_index.snapshot().items():
+            assert page not in free_set, \
+                "page simultaneously free-listed and index-mapped"
+    finally:
+        eng.close()
+
+
+# -- sequence-sharded prefill ----------------------------------------------
+
+
+def test_seq_sharded_prefill_token_identity(params, cpu_devices):
+    """Ring-attention sequence-sharded prefill over a dp=1 x sp=2 CPU
+    mesh produces the same greedy stream as the single-replica paged
+    prefill, and the KV lands in the normal paged layout (decode and
+    prefix registration just work)."""
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    plain = make_engine(params)
+    seq = make_engine(
+        params, shardings=ShardingPlan(build_mesh(2, dp=1, sp=2, tp=1)),
+        seq_prefill_min=128,
+    )
+    assert seq._seq_attn is not None
+    try:
+        ids = prompt_of(300, seed=8)
+        out_plain = plain.generate(ids, max_new_tokens=24, temperature=0.0)
+        out_seq = seq.generate(ids, max_new_tokens=24, temperature=0.0)
+        assert out_plain == out_seq
+        assert seq.prefill_seq_sharded == 1
+        # below the routing floor the normal bucket path serves
+        short = prompt_of(64, seed=9)
+        out_a = plain.generate(short, max_new_tokens=8, temperature=0.0)
+        out_b = seq.generate(short, max_new_tokens=8, temperature=0.0)
+        assert out_a == out_b
+        assert seq.prefill_seq_sharded == 1
+    finally:
+        plain.close()
+        seq.close()
+
+
+def test_seq_prefill_composes_with_compression(params, cpu_devices):
+    """A compressed long-context slot admitted via sharded prefill: the
+    whole-mesh admission lands, pruning caps residency right after, and
+    decode is deterministic — the two tentpole mechanisms compose."""
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    eng = make_engine(
+        params, shardings=ShardingPlan(build_mesh(2, dp=1, sp=2, tp=1)),
+        seq_prefill_min=128, kv_compress_after=256, kv_sink_pages=1,
+        kv_window_pages=4,
+    )
+    try:
+        ids = prompt_of(400, seed=10)
+        out1 = eng.generate(ids, max_new_tokens=24, temperature=0.0)
+        assert eng.prefill_seq_sharded == 1
+        assert eng.kv_pages_pruned > 0
+        out2 = eng.generate(ids, max_new_tokens=24, temperature=0.0)
+        assert out1 == out2
+    finally:
+        eng.close()
+
+
+def test_seq_prefill_warmup_keeps_compile_counters_flat(params,
+                                                        cpu_devices):
+    """The sp-sharded prefill graphs AOT-compile behind warmup() (the
+    PR 6 invariant): serving a routed prompt afterwards compiles
+    nothing."""
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    eng = make_engine(
+        params, shardings=ShardingPlan(build_mesh(2, dp=1, sp=2, tp=1)),
+        seq_prefill_min=128, kv_compress_after=256, kv_sink_pages=1,
+        kv_window_pages=4,
+    )
+    try:
+        eng.warmup(step_sizes=(1, 8))
+        before = eng.compile_events
+        ids = prompt_of(400, seed=11)
+        eng.prefill(0, ids, temperature=0.0)
+        eng.step(8)
+        eng.step(1)
+        eng.release(0)
+        assert eng.compile_events == before
+        assert eng.prefill_seq_sharded == 1
+    finally:
+        eng.close()
+
+
+# -- speculation guard over pruned slots -----------------------------------
+
+
+def test_propose_ngram_min_pos_clamps_to_live_rows():
+    """With min_pos set, an n-gram match that exists only below the live
+    window produces NO draft; the same match inside the window still
+    proposes."""
+    S, C = 1, 64
+    hist = np.zeros((S, C + spec.HISTORY_PAD), np.int32)
+    # pattern [5, 6] at positions 2..3 (pruned region) with continuation
+    # 7, 8; trailing pattern ends at the pending token
+    seqs = [5, 6, 7, 8] + [9] * 40 + [5, 6]
+    hist[0, : len(seqs)] = seqs
+    lengths = jnp.asarray([len(seqs) - 1], jnp.int32)
+    h = jnp.asarray(hist)
+    drafts, num = spec.propose_ngram(h, lengths, 4, 2, C)
+    assert int(num[0]) > 0  # unclamped: the early match proposes
+    drafts, num = spec.propose_ngram(
+        h, lengths, 4, 2, C, min_pos=jnp.asarray([16], jnp.int32)
+    )
+    assert int(num[0]) == 0  # clamped: the only match is pruned away
+
+    # a match INSIDE the live window still proposes under the clamp
+    seqs2 = [9] * 20 + [5, 6, 7, 8] + [9] * 10 + [5, 6]
+    hist2 = np.zeros((S, C + spec.HISTORY_PAD), np.int32)
+    hist2[0, : len(seqs2)] = seqs2
+    drafts, num = spec.propose_ngram(
+        jnp.asarray(hist2), jnp.asarray([len(seqs2) - 1], jnp.int32),
+        4, 2, C, min_pos=jnp.asarray([16], jnp.int32),
+    )
+    assert int(num[0]) > 0
+
+
+def test_spec_on_pruned_slot_stays_greedy_exact(params):
+    """n-gram speculation over a pruned slot emits exactly the plain
+    decode stream of the SAME compressed engine — proposals are clamped
+    to live rows and verify runs under the pruned mask, so acceptance
+    is judged only against context the model actually sees."""
+    a = make_engine(params, kv_compress_after=256, kv_sink_pages=1,
+                    kv_window_pages=4)
+    b = make_engine(params, kv_compress_after=256, kv_sink_pages=1,
+                    kv_window_pages=4)
+    try:
+        # repetitive tail gives the proposer something to match
+        ids = prompt_of(280, seed=12) + [5, 6, 7, 8] * 6
+        plain = a.generate(ids, max_new_tokens=24, temperature=0.0)
+        fast = b.generate(ids, max_new_tokens=24, temperature=0.0,
+                          speculative=True, draft_len=4, ngram=2)
+        assert plain == fast
+        assert int(b._win_starts.sum()) == 0  # released
+    finally:
+        a.close()
+        b.close()
+
+
+def test_draft_proposer_skips_pruned_slots(params):
+    """The draft-model proposer's ok gate excludes pruned slots: its
+    dense KV mirrors the full history while the serving attention no
+    longer sees the middle, so a pruned slot takes plain rounds
+    (proposed == 0) and the stream still matches plain decode."""
+    draft = spec.DraftModel(CFG, params, quantize=None)
+    eng = make_engine(params, kv_compress_after=256, kv_sink_pages=1,
+                      kv_window_pages=4, draft=draft)
+    plain = make_engine(params, kv_compress_after=256, kv_sink_pages=1,
+                        kv_window_pages=4)
+    try:
+        ids = prompt_of(300, seed=13)
+        first = eng.prefill(0, ids, temperature=0.0)
+        chain = [first]
+        step_toks = eng.step(16)  # cross the threshold -> slot prunes
+        chain += [int(t) for t in step_toks[:, 0]]
+        assert int(eng._win_starts[0]) > 0
+        toks, counts, proposed = eng.spec_step_draft(4, draft_len=3)
+        assert int(proposed[:, 0].sum()) == 0
+        assert (counts[:, 0] == 1).all()  # plain one-token rounds
+        for r in range(toks.shape[0]):
+            chain += [int(t) for t in toks[r, 0, : counts[r, 0]]]
+        eng.release(0)
+        ref = plain.generate(ids, max_new_tokens=len(chain),
+                             temperature=0.0)
+        assert chain == ref
+    finally:
+        eng.close()
+        plain.close()

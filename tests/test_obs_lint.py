@@ -5,7 +5,10 @@ Rules (docs/OBSERVABILITY.md "naming"):
   * prefix ``aios_tpu_``, snake_case ``[a-z0-9_]`` only;
   * a unit suffix from the approved set — ``_seconds``, ``_bytes``,
     ``_total`` (primary trio), plus ``_ratio`` and ``_per_second`` for
-    unitless/rate gauges;
+    unitless/rate gauges and ``_pages`` for KV page-pool occupancy
+    gauges (pages are the pool's native capacity unit — converting to
+    bytes at scrape time would bake in dtype/geometry and break A/B
+    comparisons across cache dtypes);
   * label names snake_case, bounded per-metric label count;
   * non-empty help text.
 """
@@ -17,7 +20,8 @@ from aios_tpu.obs.metrics import REGISTRY
 
 NAME_RE = re.compile(r"^aios_tpu_[a-z0-9_]+$")
 LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_per_second")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_per_second",
+                 "_pages")
 
 
 def _catalog():
@@ -102,6 +106,55 @@ def test_serving_family_complete_and_typed():
         if m.name.startswith("aios_tpu_serving_")
     }
     assert serving == SERVING_EXPECTED
+
+
+# -- the long-context tier family (window+sink compression + sp prefill) --
+
+KV_COMPRESS_EXPECTED = {
+    "aios_tpu_kv_compress_slots_total": "gauge",
+    "aios_tpu_kv_compress_pages_pruned_total": "gauge",
+    "aios_tpu_kv_compress_resident_pages": "gauge",
+}
+
+
+def test_kv_compress_family_complete_and_typed():
+    """The window+sink compression instruments the ISSUE 13 catalog
+    promises exist, with the promised kinds — and any NEW
+    aios_tpu_kv_compress_* metric must be added here (and to
+    docs/ENGINE_PERF.md + OBSERVABILITY.md) so the family stays
+    reviewed. slots/pages_pruned are monotonic engine counters summed
+    over the per-model engine WeakSet; resident_pages reads live
+    allocator state at scrape time."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_kv_compress_")
+    }
+    assert family == KV_COMPRESS_EXPECTED
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_kv_compress_") or \
+                m.name == "aios_tpu_prefill_seq_sharded_total":
+            assert tuple(m.labelnames) == ("model",), (
+                f"{m.name}: long-context metrics carry exactly the model "
+                f"label (replicas aggregate through the engine WeakSet)"
+            )
+
+
+def test_seq_prefill_counter_registered_over_engine_weakset():
+    """aios_tpu_prefill_seq_sharded_total and the compression counters
+    must register through the WeakSet-summed callbacks in
+    _register_gauges (set_function is last-writer-wins across replica
+    engines — the aios_tpu_prefix_host_* lesson)."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu.engine import engine as engine_mod
+
+    assert any(
+        m.name == "aios_tpu_prefill_seq_sharded_total" for m in _catalog()
+    )
+    mi = module_info_for(engine_mod)
+    used = names_used_in(mi.functions["TPUEngine._register_gauges"].node)
+    for name in ("KV_COMPRESS_SLOTS", "KV_COMPRESS_PAGES_PRUNED",
+                 "KV_COMPRESS_RESIDENT", "PREFILL_SEQ_SHARDED"):
+        assert name in used, f"{name} not registered over the WeakSet"
 
 
 # -- the prefix-cache host tier family (engine/paged.py HostPageStore) -----
