@@ -76,6 +76,7 @@ LOCKS: Tuple[LockDecl, ...] = (
     LockDecl("faults", "aios_tpu.faults.inject", "FaultPlan", "_lock"),
     LockDecl("failover", "aios_tpu.serving.failover", "FailoverHandle",
              "_lock"),
+    LockDecl("devprof", "aios_tpu.obs.devprof", "DevprofLedger", "_lock"),
 )
 
 

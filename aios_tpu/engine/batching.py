@@ -139,10 +139,16 @@ class _PendingTick:
     """One pipelined decode dispatch in flight: the engine's pending
     handle plus the live map snapshotted AT DISPATCH TIME — its tokens
     belong to the requests that were live then (requests retired since
-    have ``done`` set and their columns are dropped at consume)."""
+    have ``done`` set and their columns are dropped at consume).
+    ``evs`` holds the flight-recorder event dicts recorded at submit so
+    a devprof timing sample (known only when the worker finishes) can
+    join them at consume time — all on the scheduler thread, and
+    readers only copy FINISHED timelines, so the late join races
+    nothing."""
 
     pending: PendingDecode
     lives: Dict[int, "_Live"]
+    evs: tuple = ()
 
 
 class RequestHandle:
@@ -983,6 +989,14 @@ class ContinuousBatcher:
             self._evict_longest(e.replica)
             return
         self._gap_wait += time.monotonic() - t0
+        dev = tick.pending.device_s
+        if dev is not None:
+            # late devprof join: the sampled device-µs of the dispatch
+            # the worker just finished, onto the events recorded at its
+            # submit (scheduler-thread-only mutation of LIVE timelines —
+            # readers copy finished rings, never these)
+            for ev in tick.evs:
+                ev["dev_us"] = round(dev * 1e6, 1)
         lengths = tick.pending.lengths
         for row in tokens:
             for slot, live in tick.lives.items():
@@ -1042,28 +1056,66 @@ class ContinuousBatcher:
 
     def _rec_dispatch(self, lives, kind: str, n: int,
                       gap: Optional[float] = None,
-                      dur_s: Optional[float] = None, **extra) -> None:
+                      dur_s: Optional[float] = None,
+                      graph: str = "step", join_sample: bool = True,
+                      **extra) -> list:
+        """Record one dispatch on every live timeline. ``graph`` names
+        the devprof GRAPH_KINDS entry this dispatch ran as: when devprof
+        is armed, a fresh timing sample of that kind joins the event as
+        ``dev_us`` (``join_sample=False`` for pipelined submits — their
+        sample lands at consume, see _consume) and the ledger's mean
+        device time, split by occupancy, accrues on each timeline's
+        estimated device_us. Returns the recorded event dicts."""
         occ = len(lives)
         fields = dict(n=n, occ=occ, **extra)
         if gap is not None:
             fields["gap_ms"] = round(gap * 1e3, 3)
         if dur_s is not None:
             fields["dur_ms"] = round(dur_s * 1e3, 3)
+        est = None
+        if self.engine._devprof is not None:
+            if join_sample:
+                s = self.engine.devprof_take_sample()
+                if s is not None and s[0] == graph:
+                    fields["dev_us"] = round(s[1] * 1e6, 1)
+            est = self.engine.devprof_est_s(graph)
+        evs = []
         for live in lives:
             rec = live.req.rec
             if rec is not None and not live.done:
-                rec.event(kind, **fields)
+                ev = rec.event(kind, **fields)
+                if ev is not None:
+                    evs.append(ev)
+                if est:
+                    rec.device_us += est * 1e6 / max(occ, 1)
+        return evs
 
     def _rec_prefill(self, live: _Live, tokens: int, t0: float,
                      reused0: float, restored0: float,
                      chunk: Optional[int] = None) -> None:
+        # pop the engine's sample FIRST (even when this request carries
+        # no timeline) so a prefill-kind sample can never linger and
+        # mis-join a later dispatch's event
+        sample = None
+        if self.engine._devprof is not None:
+            sample = self.engine.devprof_take_sample()
         rec = live.req.rec
         if rec is None:
             return
+        dur_s = time.monotonic() - t0
         fields = dict(
             tokens=tokens,
-            dur_ms=round((time.monotonic() - t0) * 1e3, 3),
+            dur_ms=round(dur_s * 1e3, 3),
         )
+        if sample is not None and sample[0] in (
+            "prefill", "chunk", "seq_prefill"
+        ):
+            fields["dev_us"] = round(sample[1] * 1e6, 1)
+        if self.engine._devprof is not None:
+            # prefill is request-exclusive and the engine call blocked
+            # through completion: bill the measured wall time (an upper
+            # bound on device time, exact on the CPU backend)
+            rec.device_us += dur_s * 1e6
         cached = getattr(self.engine, "prefix_rows_reused", 0) - reused0
         restored = (
             getattr(self.engine, "prefix_rows_restored", 0) - restored0
@@ -1107,6 +1159,19 @@ class ContinuousBatcher:
                 # and ding SLO availability for a request the client
                 # may yet see complete
                 return
+        # terminal from here on: bill the tenant's estimated device
+        # seconds ONCE (finish() freezes the timeline below; a
+        # failover-resumed request reaches this point only on its final
+        # attempt, with device_us accumulated across every attempt)
+        if (
+            self.engine._devprof is not None
+            and rec.device_us
+            and not rec.finished_at
+        ):
+            obs.DEVPROF_TENANT_SECONDS.labels(tenant=rec.tenant).inc(
+                rec.device_us / 1e6
+            )
+        if live.abort_reason:
             flightrec.RECORDER.finish(
                 rec, "aborted", abort_reason=live.abort_reason
             )
@@ -1415,6 +1480,15 @@ class ContinuousBatcher:
             self._evict_longest(e.replica)  # retry next tick
             return True
         dur_ms = round((self._gap_mark - t0) * 1e3, 3)
+        dev_us = None
+        est_us = 0.0
+        if self.engine._devprof is not None:
+            s = self.engine.devprof_take_sample()
+            if s is not None and s[0] == "jump":
+                dev_us = round(s[1] * 1e6, 1)
+            est = self.engine.devprof_est_s("jump")
+            if est:
+                est_us = est * 1e6 / max(len(runs), 1)
         by_slot = dict(constrained)
         for s_ in sorted(runs):
             live = by_slot[s_]
@@ -1427,7 +1501,10 @@ class ContinuousBatcher:
                     dur_ms=dur_ms,
                     **({"gap_ms": round(gap * 1e3, 3)}
                        if gap is not None else {}),
+                    **({"dev_us": dev_us}
+                       if dev_us is not None else {}),
                 )
+                rec.device_us += est_us
             for tok in runs[s_]:
                 live.constraint.advance(tok)
                 self._emit(live, tok)
@@ -1527,7 +1604,7 @@ class ContinuousBatcher:
                 return
             self._rec_dispatch(
                 slots.values(), "decode", 1, gap,
-                self._gap_mark - t0, constrained=True,
+                self._gap_mark - t0, graph="masked", constrained=True,
             )
             for slot, live in list(slots.items()):
                 if live.done:
@@ -1580,6 +1657,16 @@ class ContinuousBatcher:
                 self._evict_longest(e.replica)  # retry next tick
                 return
             dur_ms = round((self._gap_mark - t0) * 1e3, 3)
+            graph = "draft_spec" if proposer == "draft" else "spec"
+            dev_us = None
+            est_us = 0.0
+            if self.engine._devprof is not None:
+                s = self.engine.devprof_take_sample()
+                if s is not None and s[0] == graph:
+                    dev_us = round(s[1] * 1e6, 1)
+                est = self.engine.devprof_est_s(graph)
+                if est:
+                    est_us = est * 1e6 / max(len(slots), 1)
             consumed: Dict[int, int] = {}
             for r in range(tokens.shape[0]):
                 for slot, live in list(slots.items()):
@@ -1602,7 +1689,10 @@ class ContinuousBatcher:
                         draft_len=self.spec_draft_len, dur_ms=dur_ms,
                         **({"gap_ms": round(gap * 1e3, 3)}
                            if gap is not None else {}),
+                        **({"dev_us": dev_us}
+                           if dev_us is not None else {}),
                     )
+                    rec.device_us += est_us
             self._spec_measure(proposer, counts, consumed, proposed)
             return
         if self.pipeline:
@@ -1620,10 +1710,13 @@ class ContinuousBatcher:
             gap = self._note_dispatch()
             handle = self.engine.step_async(n)
             self._gap_mark = time.monotonic()
-            self._pending = _PendingTick(handle, slots)
-            self._rec_dispatch(
-                slots.values(), "decode", n, gap, pipelined=True
+            # the worker's timing sample (if this dispatch drew one)
+            # joins these events at consume time — see _consume
+            evs = self._rec_dispatch(
+                slots.values(), "decode", n, gap, pipelined=True,
+                join_sample=False,
             )
+            self._pending = _PendingTick(handle, slots, tuple(evs))
             if prev is not None:
                 self._consume(prev)
             return

@@ -39,7 +39,7 @@ from .config import ModelConfig
 from .. import faults
 from ..analysis.locks import make_lock
 from ..obs import instruments as obs
-from ..obs import flightrec
+from ..obs import devprof, flightrec
 
 log = logging.getLogger("aios.engine")
 
@@ -269,9 +269,14 @@ class PendingDecode:
     (pipeline-on output would otherwise retire early and diverge from
     pipeline-off). ``wait_started()`` blocks until the dispatch holds the
     engine lock: ordering fence for callers about to issue further
-    engine calls that must land AFTER this dispatch."""
+    engine calls that must land AFTER this dispatch. ``device_s``
+    (valid after ``wait()``) carries the dispatch's sampled device-time
+    measurement when devprof took one (obs/devprof.py), None otherwise —
+    the batcher joins it onto the flight-recorder event it recorded at
+    submit time."""
 
-    __slots__ = ("_fut", "_started", "n_steps", "tokens", "lengths")
+    __slots__ = ("_fut", "_started", "n_steps", "tokens", "lengths",
+                 "device_s")
 
     def __init__(self, fut, n_steps: int, started: threading.Event) -> None:
         self._fut = fut
@@ -279,6 +284,7 @@ class PendingDecode:
         self.n_steps = int(n_steps)
         self.tokens: Optional[np.ndarray] = None
         self.lengths: Optional[np.ndarray] = None
+        self.device_s: Optional[float] = None
 
     def wait_started(self) -> None:
         if self.tokens is not None or self._fut.done():
@@ -287,7 +293,7 @@ class PendingDecode:
 
     def wait(self) -> np.ndarray:
         if self.tokens is None:
-            self.tokens, self.lengths = self._fut.result()
+            self.tokens, self.lengths, self.device_s = self._fut.result()
         return self.tokens
 
 
@@ -969,6 +975,14 @@ class TPUEngine:
         # exists to prevent) is visible instead of a mystery latency spike.
         self.compile_events = 0
         self.compile_seconds = 0.0
+        # Device-time attribution (obs/devprof.py): per-graph cost
+        # ledger + sampled dispatch timing, OFF by default — the hot
+        # paths pay one attribute None-check, the faults/ pattern. Read
+        # at construction like the pipeline knob: a live engine never
+        # grows instrumentation mid-serving.
+        self._devprof: Optional[devprof.DevprofLedger] = None
+        if devprof.enabled():
+            self._devprof = devprof.DevprofLedger(cfg.name)
         self._obs_decode_steps = obs.ENGINE_DECODE_STEPS.labels(model=cfg.name)
         self._register_gauges()
 
@@ -1052,6 +1066,63 @@ class TPUEngine:
             obs.SPEC_ACCEPTED.labels(model=name, proposer=p).set_function(
                 proposer_sum("spec_proposer_accepted", p)
             )
+        if self._devprof is not None:
+            # devprof family: per-graph children iterate the CLOSED
+            # devprof.GRAPH_KINDS enum (the SLO-objectives pattern) and
+            # SUM over the per-model WeakSet of replica ledgers. The
+            # MFU / HBM-utilization gauges register only when the
+            # device_kind's roofline is known (docs/HARDWARE.md) —
+            # unknown kinds keep raw seconds and omit the ratios.
+            ledgers = devprof.ledgers_for(name)
+
+            def ledger_sum(kind, idx):
+                def read() -> float:
+                    return float(sum(
+                        led.totals(kind)[idx] for led in ledgers
+                    ))
+
+                return read
+
+            def ledger_device_s(kind):
+                def read() -> float:
+                    return float(sum(
+                        led.device_seconds(kind) for led in ledgers
+                    ))
+
+                return read
+
+            def ledger_util(kind, idx, peak_idx):
+                # weighted across replicas: sum sampled flops/bytes over
+                # sum sampled seconds (a per-replica mean-of-ratios
+                # would over-weight idle replicas)
+                def read() -> float:
+                    num = sum(led.totals(kind)[idx] for led in ledgers)
+                    den = sum(led.totals(kind)[4] for led in ledgers)
+                    peaks = next(
+                        (led.peaks for led in ledgers
+                         if led.peaks is not None), None,
+                    )
+                    if not den or peaks is None:
+                        return 0.0
+                    return float(num / den / peaks[peak_idx])
+
+                return read
+
+            roofline = self._devprof.peaks is not None
+            for g in devprof.GRAPH_KINDS:
+                obs.DEVPROF_DISPATCHES.labels(
+                    model=name, graph=g
+                ).set_function(ledger_sum(g, 0))
+                obs.DEVPROF_DEVICE_SECONDS.labels(
+                    model=name, graph=g
+                ).set_function(ledger_device_s(g))
+                if roofline:
+                    obs.DEVPROF_MFU.labels(
+                        model=name, graph=g
+                    ).set_function(ledger_util(g, 5, 0))
+                    obs.DEVPROF_HBM_UTIL.labels(
+                        model=name, graph=g
+                    ).set_function(ledger_util(g, 6, 1))
         if self.allocator is not None:
             def pages_in_use() -> float:
                 e = ref()
@@ -1876,6 +1947,63 @@ class TPUEngine:
 
         return wrapper
 
+    # -- device-time attribution hooks (obs/devprof.py) ---------------------
+    # Hot-path contract: one attribute None-check when devprof is off.
+    # ``_devprof_note`` counts the dispatch ALWAYS (the per-graph
+    # ledger) and returns a timing token only when this dispatch is due
+    # a sample; its kind argument must be a devprof.GRAPH_KINDS literal
+    # (tests/test_obs_lint.py enumerates the call sites on the AST).
+    # ``_devprof_sample`` lands the host-measured completion delta —
+    # call it after the result is already known ready (past an
+    # np.asarray readback, or submit-side for deliberately-async
+    # dispatches like the restore scatter); ``_devprof_sample_sync``
+    # blocks on ``arrays`` first, so it must NEVER run under a declared
+    # lock (the lock-readback rule the analyzer enforces).
+
+    def _devprof_note(self, kind: str, key=None, need_slack: bool = False):
+        dp = self._devprof
+        if dp is None:
+            return None
+        due = dp.note(kind, key)
+        if due and need_slack and dp.queue_depth() > 1:
+            # the depth-2 double buffer has a dispatch queued behind this
+            # one: skip the sample rather than ever delaying it
+            due = False
+        return (kind, key, time.perf_counter()) if due else None
+
+    def _devprof_sample(self, tok) -> Optional[float]:
+        if tok is None:
+            return None
+        kind, key, t0 = tok
+        dt = time.perf_counter() - t0
+        self._devprof.sample(kind, key, dt)
+        return dt
+
+    def _devprof_sample_sync(self, tok, arrays) -> Optional[float]:
+        if tok is None:
+            return None
+        jax.block_until_ready(arrays)
+        return self._devprof_sample(tok)
+
+    def devprof_est_s(self, kind: str) -> Optional[float]:
+        """Mean sampled device-seconds per ``kind`` dispatch (None when
+        devprof is off or unsampled) — the batcher's per-request
+        attribution rate."""
+        dp = self._devprof
+        return dp.mean_s(kind) if dp is not None else None
+
+    def devprof_take_sample(self):
+        """Pop the ledger's most recent (kind, seconds) sample — the
+        batcher joins it onto the flight-recorder event of the dispatch
+        it just issued."""
+        dp = self._devprof
+        return dp.take_last_sample() if dp is not None else None
+
+    def devprof_snapshot(self) -> Optional[dict]:
+        """The per-graph ledger as a JSON-shaped dict (bench_devprof)."""
+        dp = self._devprof
+        return dp.snapshot() if dp is not None else None
+
     # -- jit builders -------------------------------------------------------
     # One builder per graph kind, shared by the LAZY getters (compile on
     # first dispatch, timed by _instrument_compile) and the AOT warmup
@@ -2023,6 +2151,12 @@ class TPUEngine:
         obs.ENGINE_XLA_COMPILE_SECONDS.labels(
             model=self.cfg.name, kind=kind
         ).observe(dt)
+        if self._devprof is not None:
+            # ledger registration: the compiled executable's static
+            # cost_analysis (FLOPs + bytes per dispatch) + compile time,
+            # under the same (kind, key) the dispatch path notes —
+            # metadata only, no device state moves
+            self._devprof.register(kind, key, fn, dt)
         store[key] = fn
 
     def _step_example(self) -> tuple:
@@ -2324,6 +2458,7 @@ class TPUEngine:
             bucket = self.bucket_for(len(seg))
             padded = np.zeros((1, bucket), dtype=np.int32)
             padded[0, : len(seg)] = seg
+            self._devprof_note("hist", ("hist", bucket))
             self.state = self._hist_fn(bucket)(
                 self.state, jnp.asarray(padded), jnp.int32(slot),
                 jnp.int32(start + pos),
@@ -2565,6 +2700,10 @@ class TPUEngine:
                 )
             return jnp.asarray(a)
 
+        # restore samples are submit-side by design: the scatter is
+        # deliberately async (it overlaps the tail prefill), so the
+        # sample covers staging + dispatch, like the restore histogram
+        dtok = self._devprof_note("restore", nb)
         try:
             act = faults.point("host_store.restore_fail", self.cfg.name)
             if act is not None:
@@ -2599,6 +2738,7 @@ class TPUEngine:
         self.host_restore_seconds += dt
         if self._obs_restore_hist is not None:
             self._obs_restore_hist.observe(dt)
+        self._devprof_sample(dtok)
         self.allocator.append_owned(slot, pages)
         hashes = [h for h, _ in entries]
         # back in HBM: re-register so the NEXT prompt maps these pages
@@ -2807,12 +2947,17 @@ class TPUEngine:
                 # true_len land on the sacrificial page and are never read
                 self.allocator.ensure(slot, true_len)
                 args.append(jnp.asarray(self.allocator.tables[slot]))
+            dtok = self._devprof_note("prefill", bucket)
             self.state, first = self._prefill_fn(bucket)(*args)
             self.active[slot] = True
             self._host_greedy[slot] = temperature < sampling.GREEDY_EPS
             self._host_lengths[slot] = true_len
             self._register_prefix(slot, token_ids, hashes)
-            return int(first)
+            first_token = int(first)
+        # int(first) above blocked through completion, so the sample is
+        # the dispatch->ready delta (landed outside the lock)
+        self._devprof_sample(dtok)
+        return first_token
 
     def _seq_route_ok(self, true_len: int) -> bool:
         """Whether a prompt of ``true_len`` rows routes through the
@@ -2844,6 +2989,7 @@ class TPUEngine:
         padded[0, :true_len] = ids
         with self._lock:
             self.allocator.ensure(slot, true_len)
+            dtok = self._devprof_note("seq_prefill", bucket)
             self.state, first = self._seq_prefill_fn(bucket)(
                 self.params,
                 self.state,
@@ -2863,7 +3009,9 @@ class TPUEngine:
             )
             self._maybe_compress(slot)
             self._register_prefix(slot, ids, hashes)
-            return int(first)
+            first_token = int(first)
+        self._devprof_sample(dtok)
+        return first_token
 
     def start_chunked_prefill(
         self,
@@ -2916,7 +3064,7 @@ class TPUEngine:
         ``self.active`` are meaningful. Lengths advance for every slot
         (fixed-shape graph), clamped at the cache end.
         """
-        tokens, _ = self._step_dispatch(n_steps)
+        tokens, _, _ = self._step_dispatch(n_steps)
         return tokens
 
     def _step_dispatch(
@@ -2928,29 +3076,48 @@ class TPUEngine:
         array, post-dispatch host lengths). ``started`` (the step_async
         worker path) is set the moment the engine lock is held, so a
         caller can fence later engine calls behind this dispatch."""
-        with self._lock:
-            if started is not None:
-                started.set()
-            tables = ()
-            if self.paged:
-                self._back_active_slots(n_steps)
-                tables = (self._tables_operand(),)
-            if self.unified_step:
-                fn, _ = self._unified_fn(n_steps)
-                self.state, tokens = fn(
-                    self.params, self.state, *tables, jnp.int32(n_steps)
+        try:
+            with self._lock:
+                if started is not None:
+                    started.set()
+                tables = ()
+                if self.paged:
+                    self._back_active_slots(n_steps)
+                    tables = (self._tables_operand(),)
+                if self.unified_step:
+                    fn, m = self._unified_fn(n_steps)
+                    # worker dispatches sample only with double-buffer
+                    # slack (nothing queued behind this one), so a
+                    # measurement never delays the next submission
+                    dtok = self._devprof_note(
+                        "step", ("uni", m), need_slack=started is not None
+                    )
+                    self.state, tokens = fn(
+                        self.params, self.state, *tables, jnp.int32(n_steps)
+                    )
+                else:
+                    fn = self._step_fn(n_steps)
+                    dtok = self._devprof_note(
+                        "step", n_steps, need_slack=started is not None
+                    )
+                    self.state, tokens = fn(
+                        self.params, self.state, *tables
+                    )
+                self.decode_steps += n_steps
+                self._obs_decode_steps.inc(n_steps)
+                self._host_lengths = np.minimum(
+                    self._host_lengths + n_steps, self.max_context - 1
                 )
-            else:
-                self.state, tokens = self._step_fn(n_steps)(
-                    self.params, self.state, *tables
-                )
-            self.decode_steps += n_steps
-            self._obs_decode_steps.inc(n_steps)
-            self._host_lengths = np.minimum(
-                self._host_lengths + n_steps, self.max_context - 1
-            )
-            lengths = self._host_lengths.copy()
-        return np.asarray(tokens)[:n_steps], lengths
+                lengths = self._host_lengths.copy()
+            host_tokens = np.asarray(tokens)[:n_steps]
+            # the readback above already blocked until the tokens
+            # materialized, so the sample is the graph-call -> ready
+            # delta at zero extra synchronization
+            sample_s = self._devprof_sample(dtok)
+            return host_tokens, lengths, sample_s
+        finally:
+            if started is not None and self._devprof is not None:
+                self._devprof.dequeue()
 
     def step_async(self, n_steps: int = 1) -> PendingDecode:
         """Run ``n_steps`` batched decode steps on the engine's dispatch
@@ -2975,6 +3142,10 @@ class TPUEngine:
                 thread_name_prefix=f"decode-dispatch-{self.cfg.name}",
             )
         started = threading.Event()
+        if self._devprof is not None:
+            # backlog accounting for the sampling slack check: the
+            # worker only times a dispatch with nothing queued behind it
+            self._devprof.enqueue()
         fut = self._dispatch_pool.submit(
             self._step_dispatch, n_steps, started
         )
@@ -2987,6 +3158,7 @@ class TPUEngine:
         Returns tokens [1, num_slots]."""
         with self._lock:
             m = jnp.asarray(mask, jnp.float32)
+            dtok = self._devprof_note("masked", "masked")
             if self.paged:
                 self._back_active_slots(1)
                 self.state, tokens = self._masked_step_fn()(
@@ -3004,7 +3176,9 @@ class TPUEngine:
         # readback OUTSIDE the lock (like _step_dispatch): concurrent
         # engine calls — force_pending_token, release, overlap probes that
         # do take the lock — need not wait for this dispatch to finish
-        return np.asarray(tokens)
+        host_tokens = np.asarray(tokens)
+        self._devprof_sample(dtok)
+        return host_tokens
 
     def jump_step(self, forced: np.ndarray, counts: np.ndarray) -> None:
         """Append grammar-FORCED token runs in ONE multi-token dispatch
@@ -3046,6 +3220,7 @@ class TPUEngine:
             if self.paged:
                 self._back_active_slots(kb + 1)
                 args = (self._tables_operand(),)
+            dtok = self._devprof_note("jump", kb)
             self.state = self._jump_fn(kb)(
                 self.params, self.state, *args,
                 jnp.asarray(forced), jnp.asarray(counts),
@@ -3057,6 +3232,13 @@ class TPUEngine:
             self._host_lengths = np.minimum(
                 self._host_lengths + counts, self.max_context - 1
             )
+            sync_ref = self.state["lengths"] if dtok is not None else None
+        if dtok is not None:
+            # jump has no token readback (the forced run IS the output);
+            # a sampled dispatch blocks on the new state OUTSIDE the lock
+            # — the constrained tick already drained the pipeline, so
+            # nothing queues behind this
+            self._devprof_sample_sync(dtok, sync_ref)
 
     def force_pending_token(self, slot: int, token_id: int) -> None:
         """Replace ``slot``'s pending (sampled-but-not-yet-consumed) token.
@@ -3113,6 +3295,9 @@ class TPUEngine:
                 args = (self._tables_operand(),)
             else:
                 args = ()
+            dtok = self._devprof_note(
+                "spec", (n_rounds, draft_len, ngram)
+            )
             self.state, (tokens, counts) = self._spec_fn(
                 n_rounds, draft_len, ngram
             )(self.params, self.state, *args)
@@ -3129,6 +3314,7 @@ class TPUEngine:
         # concurrent peek/stats callers must not wait on the transfer
         counts = np.asarray(counts)
         tokens = np.asarray(tokens)
+        self._devprof_sample(dtok)
         # fold the data-dependent length advance back in under the lock;
         # dispatches all come from the scheduler thread (spec ticks flush
         # the pipeline first), so nothing interleaves between the two
@@ -3177,6 +3363,9 @@ class TPUEngine:
                 args = (self._tables_operand(),)
             else:
                 args = ()
+            dtok = self._devprof_note(
+                "draft_spec", (n_rounds, draft_len, draft_len + 1)
+            )
             self.state, self.draft_state, (tokens, counts, proposed) = (
                 self._draft_spec_fn(n_rounds, draft_len)(
                     self.params, self.draft.params, self.state,
@@ -3196,6 +3385,7 @@ class TPUEngine:
         tokens = np.asarray(tokens)
         proposed = np.asarray(proposed)
         d_len = np.asarray(self.draft_state["lengths"])
+        self._devprof_sample(dtok)
         with self._lock:
             emitted = int(counts[:, self.active].sum())
             self.spec_tokens += emitted
@@ -3227,6 +3417,7 @@ class TPUEngine:
                 return
             w = next((b for b in buckets if b >= gap_max), buckets[-1])
             with self._lock:
+                dtok = self._devprof_note("draft_ingest", ("ingest", w))
                 self.draft_state = self._draft_ingest_fn(w)(
                     self.draft.params, self.draft_state,
                     self.state["history"], self.state["lengths"],
@@ -3236,6 +3427,7 @@ class TPUEngine:
             self._draft_host_lengths = np.asarray(
                 self.draft_state["lengths"]
             ).astype(np.int64)
+            self._devprof_sample(dtok)
 
     def release(self, slot: int) -> None:
         self.active[slot] = False
@@ -3663,6 +3855,7 @@ class ChunkedPrefill:
                     extra += (
                         jnp.int32(int(eng._win_starts[self.slot])),
                     )
+            dtok = eng._devprof_note("chunk", (bucket, final))
             if final:
                 eng.state, first = eng._chunk_fn(bucket, True)(
                     eng.params,
@@ -3692,6 +3885,9 @@ class ChunkedPrefill:
                     jnp.int32(self.pos),
                     *extra,
                 )
+        # final chunks blocked on int(first) above; mid-chunk samples
+        # are submit-side (their writes overlap the next chunk's staging)
+        eng._devprof_sample(dtok)
         self.pos += n
         return self.first_token
 

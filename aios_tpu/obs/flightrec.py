@@ -149,7 +149,8 @@ class Timeline:
         "prompt_tokens", "t0_wall", "t0", "events", "dropped_events",
         "state", "replica", "route_reason", "shed_cause", "abort_cause",
         "abort_detail", "retry_after_ms", "queue_wait_ms", "ttft_ms",
-        "tpot_ms", "tokens_out", "finished_at", "__weakref__",
+        "tpot_ms", "tokens_out", "device_us", "finished_at",
+        "__weakref__",
     )
 
     def __init__(self, model: str, request_id: str, tenant: str,
@@ -175,14 +176,24 @@ class Timeline:
         self.ttft_ms = 0.0
         self.tpot_ms = 0.0
         self.tokens_out = 0
+        # estimated device-microseconds attributed to this request
+        # (obs/devprof.py: per-dispatch ledger means split by batch
+        # occupancy + measured prefill time); 0 unless devprof is armed
+        self.device_us = 0.0
         self.finished_at = 0.0  # monotonic, 0 while live
 
-    def event(self, kind: str, **fields) -> None:
-        """Append one event (bounded; drops count rather than grow)."""
+    def event(self, kind: str, **fields) -> Optional[dict]:
+        """Append one event (bounded; drops count rather than grow).
+        Returns the stored fields dict so the owning scheduler thread
+        can join late-arriving per-dispatch data (the pipelined decode
+        worker's sampled device-µs lands at consume time) — readers only
+        see FINISHED timelines (the rings), so an owner-side join on a
+        live one never races a /debug copy."""
         if len(self.events) >= MAX_EVENTS:
             self.dropped_events += 1
-            return
+            return None
         self.events.append((time.monotonic() - self.t0, kind, fields))
+        return fields
 
     @property
     def duration_ms(self) -> float:
@@ -209,6 +220,7 @@ class Timeline:
             "ttft_ms": round(self.ttft_ms, 3),
             "tpot_ms": round(self.tpot_ms, 3),
             "tokens_out": self.tokens_out,
+            "device_us": round(self.device_us, 1),
             "duration_ms": round(self.duration_ms, 3),
             "dropped_events": self.dropped_events,
         }

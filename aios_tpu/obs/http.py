@@ -25,7 +25,13 @@ Routes:
   * ``/debug/slo``       — per-model objective evaluation + per-tenant
     breakdown;
   * ``/debug/snapshots`` — frozen anomaly snapshots (``?id=`` for one,
-    metadata list otherwise).
+    metadata list otherwise);
+  * ``/debug/devprof``   — the device-time attribution ledgers (per
+    model/graph dispatches, device-seconds, MFU/HBM utilization) +
+    capture status (``?model=``);
+  * ``/debug/profile``   — start a bounded on-demand ``jax.profiler``
+    capture (``?secs=N``, capped, one at a time → 409 while busy,
+    403 unless ``AIOS_TPU_DEVPROF_DUMP_DIR`` is set).
 """
 
 from __future__ import annotations
@@ -47,11 +53,11 @@ def _debug_response(
     path: str, query: dict,
 ) -> Optional[Tuple[bytes, str, int]]:
     """Render one /debug/* route -> (body, content_type, status), or
-    None for an unknown path. flightrec/slo import at call time because
-    the obs package __init__ imports THIS module before them (they are
-    package-level imports everywhere else — every process importing
-    aios_tpu.obs has them loaded)."""
-    from . import flightrec, slo, tracing
+    None for an unknown path. flightrec/slo/devprof import at call time
+    because the obs package __init__ imports THIS module before them
+    (they are package-level imports everywhere else — every process
+    importing aios_tpu.obs has them loaded)."""
+    from . import devprof, flightrec, slo, tracing
 
     def q(name: str, default: str = "") -> str:
         return query.get(name, [default])[0]
@@ -148,6 +154,25 @@ def _debug_response(
                     for s in snaps
                 ],
             })
+    elif path == "/debug/devprof":
+        body = json.dumps(devprof.snapshot_all(model=q("model")))
+    elif path == "/debug/profile":
+        try:
+            secs = float(q("secs", "2") or 2)
+        except ValueError:
+            secs = 2.0
+        try:
+            body = json.dumps(devprof.start_capture(secs))
+        except devprof.CaptureDisabled as exc:
+            # 403, not 404: the route exists, the deployment opted out
+            # (no dump dir); a curl -f script reads the distinction
+            body = json.dumps({"error": str(exc)})
+            status = 403
+        except devprof.CaptureBusy as exc:
+            # one capture at a time — a second request must not stack a
+            # profiler session on the live plane
+            body = json.dumps({"error": str(exc)})
+            status = 409
     else:
         return None
     return body.encode("utf-8"), "application/json", status

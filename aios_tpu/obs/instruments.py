@@ -369,6 +369,53 @@ SERVING_FAILOVERS = Counter(
     ("model", "outcome"),
 )
 
+# -- device-time attribution (obs/devprof.py, docs/OBSERVABILITY.md) -------
+# Armed by AIOS_TPU_DEVPROF; every series' ``graph`` label is drawn from
+# the CLOSED devprof.GRAPH_KINDS enum (the engine registers the children
+# by iterating it — the SLO-objectives pattern), and all per-graph
+# series are monotonic ledger counters read at scrape time, SUMMED over
+# the per-model WeakSet of live replica ledgers (set_function is
+# last-writer-wins — the aios_tpu_prefix_host_* lesson). Only the
+# tenant counter is a true Counter, and it carries the tenant label
+# ALONE (the quota-metric precedent: a tenant x model product is
+# unbounded; the per-model breakdown lives in /debug/devprof JSON).
+
+DEVPROF_DISPATCHES = Gauge(
+    "aios_tpu_devprof_dispatches_total",
+    "Device dispatches per serving-graph kind (graph in the closed "
+    "devprof.GRAPH_KINDS enum; monotonic, summed over replica ledgers)",
+    ("model", "graph"),
+)
+DEVPROF_DEVICE_SECONDS = Gauge(
+    "aios_tpu_devprof_device_seconds_total",
+    "Estimated device-busy seconds per graph kind: mean sampled "
+    "completion time extrapolated over all dispatches (monotonic-ish, "
+    "summed over replica ledgers; raw even when the roofline is unknown)",
+    ("model", "graph"),
+)
+DEVPROF_MFU = Gauge(
+    "aios_tpu_devprof_mfu_ratio",
+    "Model FLOPs utilization per graph kind: static cost_analysis FLOPs "
+    "of sampled dispatches / sampled seconds / the device_kind's peak "
+    "FLOP/s (docs/HARDWARE.md roofline table; omitted on unknown kinds)",
+    ("model", "graph"),
+)
+DEVPROF_HBM_UTIL = Gauge(
+    "aios_tpu_devprof_hbm_bandwidth_utilization_ratio",
+    "HBM bandwidth utilization per graph kind: cost_analysis bytes of "
+    "sampled dispatches / sampled seconds / the device_kind's peak "
+    "HBM bytes/s (docs/HARDWARE.md; omitted on unknown kinds)",
+    ("model", "graph"),
+)
+DEVPROF_TENANT_SECONDS = Counter(
+    "aios_tpu_devprof_tenant_device_seconds_total",
+    "Estimated device-seconds billed per tenant at request retirement "
+    "(timeline attribution: per-dispatch ledger means split by batch "
+    "occupancy + measured prefill time; per-model detail in "
+    "/debug/devprof)",
+    ("tenant",),
+)
+
 # -- fault injection (aios_tpu/faults/, docs/FAULTS.md) --------------------
 
 FAULTS_INJECTED = Counter(

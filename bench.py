@@ -50,6 +50,12 @@ import time
 V5E_HBM_GBPS = 819.0  # v5e chip peak HBM bandwidth
 BASELINE_CPU_TPS = 15.0  # top of the reference's published range
 
+# Bench JSON-line schema version: bump whenever line fields change shape
+# or meaning, so scripts/benchdiff.py can REFUSE a cross-schema
+# comparison instead of silently mis-diffing two incompatible captures
+# (rides beside the platform/device_kind stamps every line carries).
+BENCH_SCHEMA_VERSION = 1
+
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
@@ -82,7 +88,8 @@ def _platform_stamp() -> dict:
 
 def emit(obj):
     stamped = dict(_platform_stamp())
-    stamped.update(obj)  # an explicit platform in obj wins
+    stamped["schema_version"] = BENCH_SCHEMA_VERSION
+    stamped.update(obj)  # an explicit platform/schema in obj wins
     print(json.dumps(stamped), flush=True)
 
 
@@ -1210,6 +1217,129 @@ def bench_dispatch():
     }
 
 
+def bench_devprof():
+    """Device-time attribution (obs/devprof.py): emit the per-graph cost
+    ledger as JSON — {dispatches, est FLOPs/bytes, sampled
+    device-seconds, MFU/HBM util where the roofline is known} per graph
+    kind — plus a devprof ON-vs-OFF overhead A/B through the pipelined
+    continuous batcher.
+
+    Two phases on purpose: the LEDGER phase runs sequential
+    single-request greedy waves so its per-graph dispatch counts are
+    deterministic — that snapshot is what scripts/benchdiff.py diffs
+    against a committed baseline (the per-graph regression sentinel) —
+    and only then do order-alternated concurrent pairs measure the
+    sampling overhead (median of paired tok/s ratios, the bench_dispatch
+    methodology; sampled at 4x the default rate, so the measured
+    overhead upper-bounds production's)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST.scaled(
+        name="micro-devprof", num_layers=1, hidden_size=32,
+        intermediate_size=64, num_heads=2, num_kv_heads=1, head_dim=16,
+        vocab_size=256, max_context=512,
+    )
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    chunk, slots, pairs = 16, 8, 7
+
+    def build(dev_on):
+        saved = {
+            k: os.environ.get(k)
+            for k in ("AIOS_TPU_DEVPROF", "AIOS_TPU_DEVPROF_SAMPLE")
+        }
+        os.environ["AIOS_TPU_DEVPROF"] = "1" if dev_on else "0"
+        if dev_on:
+            os.environ["AIOS_TPU_DEVPROF_SAMPLE"] = "8"
+        try:
+            eng = TPUEngine(cfg, params, num_slots=slots, max_context=512,
+                            cache_dtype=jnp.float32)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        eng.warmup(step_sizes=(2, chunk), prefill_chunk=0)
+        return eng, ContinuousBatcher(
+            eng, chunk_steps=chunk, admit_chunk_steps=2, pipeline=True,
+        )
+
+    def wave(batcher, n=slots, max_tokens=128):
+        handles = [
+            batcher.submit(Request(prompt_ids=[3 + i, 17, 91],
+                                   max_tokens=max_tokens, temperature=0.0))
+            for i in range(n)
+        ]
+        t0 = time.time()
+        out = [h.tokens() for h in handles]
+        return sum(len(t) for t in out) / (time.time() - t0), out
+
+    arms = []
+    try:
+        for dev_on in (False, True):
+            arms.append(build(dev_on))
+        eng_on, b_on = arms[1]
+        # phase 1 — deterministic ledger: sequential single-request
+        # waves (no admission-timing variance in the chunk-size choice)
+        for i in range(6):
+            b_on.submit(Request(prompt_ids=[5 + i, 9, 42], max_tokens=32,
+                                temperature=0.0)).tokens()
+        ledger = eng_on.devprof_snapshot()
+        # phase 2 — overhead A/B: both arms resident, waves alternate
+        wave(arms[0][1])
+        wave(b_on)
+        ratios, identical = [], True
+        for pair in range(pairs):
+            order = (0, 1) if pair % 2 == 0 else (1, 0)
+            got = {}
+            for idx in order:
+                got[idx] = wave(arms[idx][1])
+            identical = identical and got[0][1] == got[1][1]
+            ratios.append(got[1][0] / max(got[0][0], 1e-9))
+    finally:
+        for eng, batcher in arms:
+            batcher.shutdown()
+            eng.close()
+    ratios_sorted = sorted(ratios)
+    ratio = statistics.median(ratios)
+    q25 = ratios_sorted[len(ratios) // 4]
+    q75 = ratios_sorted[-1 - len(ratios) // 4]
+    graphs = (ledger or {}).get("graphs", {})
+    total_dev_s = sum(
+        g.get("device_seconds", 0.0) for g in graphs.values()
+    )
+    log(f"[devprof] ledger graphs {sorted(graphs)} total est device "
+        f"{total_dev_s:.4f}s; on/off ratio median {ratio:.3f} "
+        f"(IQR {q25:.3f}-{q75:.3f}), identical={identical}")
+    return {
+        "metric": "devprof per-graph device-time ledger + sampling "
+                  f"overhead A/B (micro geometry, {pairs} "
+                  "order-alternated paired waves)",
+        "value": round(ratio, 3),
+        "unit": "x tok/s (devprof on vs off, median of paired waves; "
+                "1.0 = free)",
+        "vs_baseline": round(ratio, 3),
+        "devprof": ledger,
+        "device_seconds_total": round(total_dev_s, 4),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "ratio_iqr": [round(q25, 3), round(q75, 3)],
+        "tokens_identical": bool(identical),
+        # this container's CPU availability swings ~2x on a seconds
+        # timescale; the median of tightly-alternated pairs is the
+        # defensible statistic, the IQR is the honesty bar
+        "cpu_cores": os.cpu_count(),
+    }
+
+
 def bench_structured():
     """Jump-ahead A/B on a schema-forced JSON workload through the
     production continuous batcher (AIOS_TPU_JUMP_AHEAD): waves of greedy
@@ -1876,6 +2006,12 @@ def main() -> int:
                          "admits chunked, window+sink KV compression "
                          "kicks in, decode continues (assertion-free, "
                          "CPU fallback fine, always exit 0)")
+    ap.add_argument("--devprof", action="store_true",
+                    help="run ONLY the device-time attribution probe: "
+                         "emit the per-graph cost ledger JSON (the "
+                         "scripts/benchdiff.py regression-sentinel "
+                         "input) + the devprof on/off overhead A/B "
+                         "(assertion-free, CPU fallback fine, exit 0)")
     ap.add_argument("--flight-dump", action="store_true",
                     help="run ONLY the flight-recorder smoke: a tiny "
                          "2-replica pool wave whose request timelines "
@@ -1902,6 +2038,17 @@ def main() -> int:
                   "value": 0.0, "unit": "verdict (1 = pass)",
                   "vs_baseline": 0.0, "error": repr(e)[:300]})
             return 1
+
+    if args.devprof:
+        try:
+            emit(bench_devprof())
+        except Exception as e:  # assertion-free: diagnose, never fail
+            log(f"[devprof] FAILED: {e!r}")
+            emit({"metric": "devprof per-graph device-time ledger + "
+                            "sampling overhead A/B",
+                  "value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
+                  "error": repr(e)[:300]})
+        return 0
 
     if args.flight_dump:
         try:
@@ -1991,8 +2138,8 @@ def main() -> int:
     extra = [] if args.skip_mistral else [bench_mixed_tier, bench_spec_decode]
     extra.extend([
         bench_paged_kv, bench_host_tier, bench_longctx, bench_dispatch,
-        bench_structured, bench_draft, bench_agent_ttft, bench_moe_gather,
-        bench_int8_kv_ragged_ab, bench_orchestrator_e2e,
+        bench_devprof, bench_structured, bench_draft, bench_agent_ttft,
+        bench_moe_gather, bench_int8_kv_ragged_ab, bench_orchestrator_e2e,
     ])
     if args.fast:
         extra = []

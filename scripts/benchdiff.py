@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Diff two bench.py JSONL captures' devprof ledgers — the per-graph
+device-time regression sentinel (docs/OBSERVABILITY.md "Device-time
+attribution").
+
+Usage:
+    scripts/benchdiff.py BASELINE.json NEW.json [--threshold 0.15]
+
+Reads both files as bench.py output (one JSON object per line), finds
+each side's ``bench_devprof`` line (the one carrying a ``devprof``
+ledger), and compares per graph kind:
+
+  * ``device_seconds_per_dispatch`` — sampled mean device time; a NEW
+    value more than ``--threshold`` above baseline is a regression;
+  * ``dispatches`` — the ledger phase's workload is fixed and
+    deterministic, so a graph kind dispatching more than ``--threshold``
+    above baseline is a regression too (a graph doing extra work for
+    the same tokens);
+  * a kind present in the baseline but missing from NEW is reported as
+    lost coverage (warning, not failure — e.g. a CPU capture diffed
+    against a TPU one legitimately drops kinds).
+
+Refuses cross-schema comparisons: both lines must carry the same
+``schema_version`` (bench.py stamps every line; a missing stamp reads
+as version 0). Exit codes: 0 clean, 1 regression past the threshold,
+2 unusable inputs (missing ledger, schema mismatch).
+
+The human-readable table goes to stderr; ONE machine-readable JSON
+verdict line goes to stdout, so CI can archive it beside the captures.
+scripts/preflight.sh runs this against the committed BASELINE_DEVPROF
+capture with a loosened threshold (cross-run CPU timing noise); same-
+machine A/Bs use the default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+
+def log(*args) -> None:
+    print(*args, file=sys.stderr, flush=True)
+
+
+def load_lines(path: str) -> List[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    obj = json.loads(raw)
+                except ValueError:
+                    continue  # interleaved log noise: skip, keep JSON
+                if isinstance(obj, dict):
+                    out.append(obj)
+    except OSError as exc:
+        log(f"benchdiff: cannot read {path}: {exc}")
+    return out
+
+
+def pick_devprof(lines: List[dict]) -> Optional[dict]:
+    """The LAST line carrying a devprof ledger (a capture may emit
+    several runs; last wins, like bench re-runs overwrite)."""
+    for obj in reversed(lines):
+        dp = obj.get("devprof")
+        if isinstance(dp, dict) and dp.get("graphs"):
+            return obj
+    return None
+
+
+def diff(base: dict, new: dict, threshold: float) -> Tuple[list, list]:
+    """-> (regressions, warnings); each entry is a dict."""
+    regressions, warnings = [], []
+    bg = base["devprof"]["graphs"]
+    ng = new["devprof"]["graphs"]
+    for kind in sorted(bg):
+        b = bg[kind]
+        n = ng.get(kind)
+        if n is None:
+            if b.get("dispatches"):
+                warnings.append({
+                    "graph": kind, "what": "coverage_lost",
+                    "detail": f"{b['dispatches']} baseline dispatches, "
+                              f"absent from new capture",
+                })
+            continue
+        b_disp, n_disp = b.get("dispatches", 0), n.get("dispatches", 0)
+        if b_disp and n_disp > b_disp * (1.0 + threshold):
+            regressions.append({
+                "graph": kind, "what": "dispatches",
+                "base": b_disp, "new": n_disp,
+                "ratio": round(n_disp / b_disp, 3),
+            })
+        b_s = b.get("device_seconds_per_dispatch")
+        n_s = n.get("device_seconds_per_dispatch")
+        if b_s and n_s:
+            ratio = n_s / b_s
+            row = {
+                "graph": kind, "what": "device_seconds_per_dispatch",
+                "base": b_s, "new": n_s, "ratio": round(ratio, 3),
+            }
+            if ratio > 1.0 + threshold:
+                regressions.append(row)
+            else:
+                warnings.append({**row, "what": "timing_ok"})
+    for kind in sorted(set(ng) - set(bg)):
+        warnings.append({
+            "graph": kind, "what": "new_coverage",
+            "detail": f"{ng[kind].get('dispatches', 0)} dispatches with "
+                      f"no baseline entry",
+        })
+    return regressions, warnings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-graph devprof regression diff of two bench.py "
+                    "JSONL captures",
+    )
+    ap.add_argument("baseline", help="baseline capture (JSONL)")
+    ap.add_argument("new", help="new capture (JSONL)")
+    ap.add_argument("--threshold", type=float, default=0.15, metavar="R",
+                    help="relative regression budget per graph kind "
+                         "(default 0.15 = +15%%; preflight loosens it "
+                         "for cross-run CPU noise)")
+    args = ap.parse_args(argv)
+
+    base = pick_devprof(load_lines(args.baseline))
+    new = pick_devprof(load_lines(args.new))
+    if base is None or new is None:
+        which = args.baseline if base is None else args.new
+        log(f"benchdiff: no devprof ledger line in {which} "
+            f"(run `python bench.py --devprof`)")
+        print(json.dumps({"verdict": "unusable", "missing": which}))
+        return 2
+
+    b_schema = base.get("schema_version", 0)
+    n_schema = new.get("schema_version", 0)
+    if b_schema != n_schema:
+        log(f"benchdiff: REFUSING cross-schema comparison "
+            f"(baseline schema_version={b_schema}, new={n_schema}); "
+            f"re-capture the baseline with this bench.py")
+        print(json.dumps({
+            "verdict": "schema_mismatch",
+            "baseline_schema": b_schema, "new_schema": n_schema,
+        }))
+        return 2
+
+    regressions, warnings = diff(base, new, args.threshold)
+    for w in warnings:
+        if w["what"] == "timing_ok":
+            log(f"  ok   {w['graph']:<13} {w['base']:.6f}s -> "
+                f"{w['new']:.6f}s/dispatch (x{w['ratio']})")
+        else:
+            log(f"  note {w['graph']:<13} {w['what']}: "
+                f"{w.get('detail', '')}")
+    for r in regressions:
+        log(f"  FAIL {r['graph']:<13} {r['what']} {r['base']} -> "
+            f"{r['new']} (x{r['ratio']}, budget +{args.threshold:.0%})")
+    verdict = "regression" if regressions else "ok"
+    log(f"benchdiff: {verdict} "
+        f"({len(regressions)} regression(s), threshold "
+        f"+{args.threshold:.0%})")
+    print(json.dumps({
+        "verdict": verdict,
+        "threshold": args.threshold,
+        "schema_version": n_schema,
+        "regressions": regressions,
+        "warnings": [w for w in warnings if w["what"] != "timing_ok"],
+    }))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,18 +232,25 @@ def test_abort_records_closed_cause_and_snapshots():
     assert tl.abort_cause == "model_unloading"
     assert tl.abort_cause in flightrec.ABORT_CAUSES
     # auto-triggered snapshots build on a background thread (the freeze
-    # must not stall the scheduler): poll briefly
-    deadline = time.monotonic() + 5.0
+    # must not stall the scheduler): poll for the snapshot CONTAINING
+    # this request — the global 8-deep snapshot store can already hold a
+    # stale (tiny-test, abort) snapshot from an earlier suite file, and
+    # exiting on the first (model, cause) match would assert against
+    # that stale freeze while this abort's build is still running
+    deadline = time.monotonic() + 10.0
     snaps = []
     while time.monotonic() < deadline and not snaps:
         snaps = [
             s for s in flightrec.RECORDER.snapshots()
             if s["model"] == TINY_TEST.name and s["cause"] == "abort"
+            and any(
+                t["request_id"] == "flight-abort-1"
+                for t in s["timelines"]
+            )
         ]
         time.sleep(0.02)
-    assert snaps, "abort must freeze an anomaly snapshot"
-    assert any(
-        t["request_id"] == "flight-abort-1" for t in snaps[-1]["timelines"]
+    assert snaps, (
+        "abort must freeze an anomaly snapshot holding this request"
     )
 
 

@@ -335,6 +335,96 @@ def test_engine_dispatch_flush_causes_bounded():
     assert causes <= {"constrained", "spec", "evict", "idle"}
 
 
+# -- the device-time attribution family (obs/devprof.py, ISSUE 14) ---------
+
+DEVPROF_EXPECTED = {
+    "aios_tpu_devprof_dispatches_total": "gauge",
+    "aios_tpu_devprof_device_seconds_total": "gauge",
+    "aios_tpu_devprof_mfu_ratio": "gauge",
+    "aios_tpu_devprof_hbm_bandwidth_utilization_ratio": "gauge",
+    "aios_tpu_devprof_tenant_device_seconds_total": "counter",
+}
+
+
+def test_devprof_family_complete_and_typed():
+    """The device-time attribution instruments the ISSUE 14 catalog
+    promises exist, with the promised kinds and unit suffixes — and any
+    NEW aios_tpu_devprof_* metric must be added here (and to
+    docs/OBSERVABILITY.md) so the family stays reviewed. Per-graph
+    series carry exactly (model, graph) and are WeakSet-summed over
+    replica ledgers; ONLY the tenant counter carries the tenant label,
+    and it carries it ALONE (the quota-metric precedent — a tenant x
+    model label product is unbounded; the per-model breakdown lives in
+    /debug/devprof JSON)."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_devprof_")
+    }
+    assert family == DEVPROF_EXPECTED
+    for m in _catalog():
+        if m.name == "aios_tpu_devprof_tenant_device_seconds_total":
+            assert tuple(m.labelnames) == ("tenant",)
+        elif m.name.startswith("aios_tpu_devprof_"):
+            assert tuple(m.labelnames) == ("model", "graph"), (
+                f"{m.name}: devprof series carry exactly (model, graph)"
+            )
+        if m.name.startswith("aios_tpu_devprof_"):
+            assert m.name.endswith(UNIT_SUFFIXES)
+
+
+def test_devprof_graph_kinds_closed_enum():
+    """The ``graph`` label values come from devprof.GRAPH_KINDS and
+    nowhere else: the engine's gauge registration iterates the tuple
+    (the SLO-objectives pattern) over the per-model ledger WeakSet, and
+    every ledger call site — the ``_devprof_note(<kind>, ...)`` hooks on
+    the dispatch paths — passes a literal member of the enum (checked on
+    the AST, so a stray string cannot mint a new series)."""
+    from aios_tpu.analysis.core import (
+        iter_calls, module_info_for, names_used_in, string_call_args,
+    )
+    from aios_tpu.engine import engine as engine_mod
+    from aios_tpu.obs import devprof
+
+    mi = module_info_for(engine_mod)
+    used = names_used_in(mi.functions["TPUEngine._register_gauges"].node)
+    assert "GRAPH_KINDS" in used, (
+        "devprof gauge children must be registered by iterating the "
+        "GRAPH_KINDS enum"
+    )
+    assert "ledgers_for" in used, (
+        "devprof gauges must aggregate over the per-model ledger WeakSet"
+    )
+    for name in ("DEVPROF_DISPATCHES", "DEVPROF_DEVICE_SECONDS",
+                 "DEVPROF_MFU", "DEVPROF_HBM_UTIL"):
+        assert name in used, f"{name} not registered over the WeakSet"
+    kinds = {
+        lit for lit, _ in string_call_args(mi.tree, ("_devprof_note",), 0)
+    }
+    assert kinds, "no _devprof_note call sites found in the engine"
+    unknown = kinds - set(devprof.GRAPH_KINDS)
+    assert not unknown, (
+        f"ledger call sites use kinds {sorted(unknown)} not in the "
+        f"closed GRAPH_KINDS enum — extend the enum (reviewed) instead "
+        f"of inventing strings"
+    )
+    # the graph kinds the BATCHER attributes by (its _rec_dispatch
+    # graph= argument and the spec/jump attribution) are members too
+    from aios_tpu.engine import batching
+    import ast as ast_mod
+
+    bi = module_info_for(batching)
+    batcher_kinds = set()
+    for call in iter_calls(bi.tree):
+        for kw in call.keywords:
+            if kw.arg == "graph" and isinstance(kw.value, ast_mod.Constant):
+                batcher_kinds.add(kw.value.value)
+    batcher_kinds |= {
+        lit for lit, _ in string_call_args(bi.tree, ("devprof_est_s",), 0)
+    }
+    assert batcher_kinds, "no batcher attribution call sites found"
+    assert batcher_kinds <= set(devprof.GRAPH_KINDS)
+
+
 # -- the SLO family (obs/slo.py, fed by the flight recorder, ISSUE 8) ------
 
 SLO_EXPECTED = {
