@@ -77,6 +77,10 @@ LOCKS: Tuple[LockDecl, ...] = (
     LockDecl("failover", "aios_tpu.serving.failover", "FailoverHandle",
              "_lock"),
     LockDecl("devprof", "aios_tpu.obs.devprof", "DevprofLedger", "_lock"),
+    # autoscale: pure bookkeeping (hold counters, action journal, the
+    # added-engine list) — engine builds and pool mutations run outside
+    LockDecl("autoscale", "aios_tpu.serving.autoscale",
+             "AutoscaleController", "_lock"),
 )
 
 

@@ -255,6 +255,10 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         put("AIOS_TPU_JSON_MODE", str(m["json_mode"]))
     if m.get("guided_toolcalls"):
         put("AIOS_TPU_GUIDED_TOOLCALLS", "1")
+    # SLO autoscaling closed loop (docs/RUNBOOK.md §8): [models]
+    # autoscale = true attaches the burn controller to every pool
+    if m.get("autoscale"):
+        put("AIOS_TPU_AUTOSCALE", "1")
     # serving-layer knobs (docs/SERVING.md): numeric; "" = unset (the
     # serving defaults apply). max_queue forwards an EXPLICIT 0 too —
     # it means unbounded, not "use the default bound".
@@ -283,6 +287,19 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         ("kv_sink_pages", "AIOS_TPU_KV_SINK_PAGES", False),
         ("kv_window_pages", "AIOS_TPU_KV_WINDOW_PAGES", False),
         ("seq_prefill_min", "AIOS_TPU_SEQ_PREFILL_MIN", True),
+        # SLO autoscaler policy (serving/autoscale.py; only meaningful
+        # with autoscale = true above)
+        ("autoscale_max_replicas", "AIOS_TPU_AUTOSCALE_MAX_REPLICAS",
+         False),
+        ("autoscale_interval_secs", "AIOS_TPU_AUTOSCALE_INTERVAL_SECS",
+         False),
+        ("autoscale_up_burn", "AIOS_TPU_AUTOSCALE_UP_BURN", False),
+        ("autoscale_down_burn", "AIOS_TPU_AUTOSCALE_DOWN_BURN", False),
+        ("autoscale_hold_ticks", "AIOS_TPU_AUTOSCALE_HOLD_TICKS", False),
+        # an explicit 0 forwards (cooldown OFF — hold ticks remain the
+        # only damping)
+        ("autoscale_cooldown_secs", "AIOS_TPU_AUTOSCALE_COOLDOWN_SECS",
+         True),
     ):
         raw = m.get(cfg_key, "")
         if raw in ("", None):

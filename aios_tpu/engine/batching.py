@@ -351,6 +351,14 @@ class ContinuousBatcher:
             p: 0 for p in self.spec_proposers
         }
         self.spec_autodisables = 0
+        # Degrade switches (serving/autoscale.py ladder): the SLO-burn
+        # controller flips these to shed OPTIONAL work under sustained
+        # burn — speculation first (failed drafts inflate per-dispatch
+        # cost), then grammar jump-ahead. Both paths are token-identical
+        # on/off by construction, so a mid-stream flip never perturbs a
+        # greedy stream; plain bool stores, safe to flip cross-thread.
+        self.degrade_spec = False
+        self.degrade_jump = False
         # Grammar jump-ahead (AIOS_TPU_JUMP_AHEAD /
         # ModelConfig.jump_ahead, default ON): chains of grammar-FORCED
         # tokens (singleton masks — schema key literals, ':', ',',
@@ -1364,6 +1372,8 @@ class ContinuousBatcher:
     def _spec_active(self) -> bool:
         """Whether the next decode tick may dispatch speculatively at
         all (any rung of the proposer ladder available)."""
+        if self.degrade_spec:
+            return False
         return self._spec_proposer() is not None
 
     def _spec_measure(self, proposer: str, counts,
@@ -1569,7 +1579,8 @@ class ContinuousBatcher:
             # unconstrained co-resident slots cost nothing (no per-slot
             # row stack, no per-step PCIe traffic).
             self._flush_pending("constrained")
-            if self.jump_ahead and self._jump_tick(constrained):
+            if self.jump_ahead and not self.degrade_jump \
+                    and self._jump_tick(constrained):
                 return
             import jax.numpy as jnp
 
@@ -1625,7 +1636,7 @@ class ContinuousBatcher:
             anyone_waiting = bool(self._waiting) or self._prefilling is not None
         n = self.admit_chunk_steps if anyone_waiting else self.chunk_steps
         proposer = None
-        if self.speculative:
+        if self.speculative and not self.degrade_spec:
             # the draft rung needs a greedy slot to propose for; without
             # one it falls through to n-gram (see _spec_proposer)
             greedy_live = any(

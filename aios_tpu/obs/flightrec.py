@@ -70,11 +70,17 @@ EVENT_KINDS = (
     "admit", "shed", "route", "queue", "prefill", "decode", "jump",
     "spec", "restore", "spill", "retire", "abort", "cancel", "span",
     "respawn", "failover", "fault", "kv_compress", "seq_prefill",
+    # "autoscale": an SLO-burn controller action (scale up/down, degrade
+    # ladder rung, restore) on the model lane (serving/autoscale.py)
+    "autoscale",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these
 # and serving/pool.py counts by them (both import this tuple).
-SHED_CAUSES = ("quota", "deadline", "queue_full", "draining")
+# "degraded" is the autoscaler's ladder rung 3: best-effort (priority <
+# the protected floor) requests shed while the pool digs out of an SLO
+# burn — the reactive/operational tiers keep admitting.
+SHED_CAUSES = ("quota", "deadline", "queue_full", "draining", "degraded")
 
 # Abort causes: the batcher's human-readable ``abort_reason`` strings
 # normalize onto this enum (the free-form text rides in the timeline's

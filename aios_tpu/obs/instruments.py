@@ -369,6 +369,21 @@ SERVING_FAILOVERS = Counter(
     ("model", "outcome"),
 )
 
+# -- SLO autoscaler (serving/autoscale.py, docs/RUNBOOK.md §8) -------------
+# ``action`` and ``cause`` are the CLOSED autoscale.ACTIONS / CAUSES
+# enums; the controller pre-registers every (action, cause) child by
+# iterating both tuples at construction (the SLO-objectives pattern), so
+# a new action is a reviewed enum change, not a stray label value.
+
+AUTOSCALE_ACTIONS = Counter(
+    "aios_tpu_autoscale_actions_total",
+    "SLO-burn autoscaler actions (action=scale_up|scale_down|degrade|"
+    "restore off the windowed burn rate; cause=burn|ceiling|recovery|"
+    "kill_switch). Every action also lands on the flight recorder's "
+    "model lane with level/replica evidence",
+    ("model", "action", "cause"),
+)
+
 # -- device-time attribution (obs/devprof.py, docs/OBSERVABILITY.md) -------
 # Armed by AIOS_TPU_DEVPROF; every series' ``graph`` label is drawn from
 # the CLOSED devprof.GRAPH_KINDS enum (the engine registers the children

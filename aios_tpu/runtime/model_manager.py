@@ -736,6 +736,46 @@ class ModelManager:
                     _m.batcher = b
 
             pool.on_respawn = _sync_batcher
+            # SLO autoscaling closed loop (AIOS_TPU_AUTOSCALE, docs/
+            # RUNBOOK.md §8): a per-pool controller scales replicas off
+            # the windowed burn rate and walks the degrade ladder at the
+            # ceiling. The engine factory clones replica 0's geometry
+            # over its LIVE (already device-resident, possibly
+            # prequantized) param tree, so a scale-up shares weight
+            # buffers instead of re-reading the checkpoint; scale-up
+            # replicas ride the manager's shared mesh (disjoint-submesh
+            # growth would need devices the plan already claimed).
+            from ..serving.autoscale import (
+                AutoscaleController, enabled as autoscale_enabled,
+            )
+
+            if autoscale_enabled():
+                def engine_factory(
+                    _cfg=cfg, _ctx=ctx, _cache=cache_dtype, _kw=kw,
+                    _spec=spec_on, _draft=draft, _pool=pool,
+                    _warm=self.warm_compile, _plan=self.plan,
+                    _slots=self.num_slots,
+                ):
+                    from .service import json_mode_forced
+
+                    e0 = _pool.replicas[0].engine
+                    eng = TPUEngine(
+                        _cfg, e0.params, num_slots=_slots,
+                        max_context=_ctx, shardings=_plan,
+                        quantize=None, cache_dtype=_cache,
+                        track_history=_spec, draft=_draft, **_kw,
+                    )
+                    if _warm:
+                        eng.warmup(masked_step=json_mode_forced())
+                    return eng
+
+                AutoscaleController(
+                    pool, engine_factory=engine_factory, start=True,
+                )
+                log.info(
+                    "%s: SLO autoscaler attached (ceiling %d replicas)",
+                    name, pool.autoscaler.cfg.max_replicas,
+                )
             with self._lock:
                 old = self.models.get(name)
                 self.models[name] = managed

@@ -9,6 +9,7 @@ sheds work a saturated pool cannot serve inside its deadline
 """
 
 from .admission import AdmissionController, AdmissionError, TokenBucket, tenant_of
+from .autoscale import AutoscaleConfig, AutoscaleController
 from .config import ServingConfig
 from .failover import FailoverHandle
 from .pool import Replica, ReplicaPool
@@ -17,6 +18,8 @@ from .router import Router
 __all__ = [
     "AdmissionController",
     "AdmissionError",
+    "AutoscaleConfig",
+    "AutoscaleController",
     "FailoverHandle",
     "Replica",
     "ReplicaPool",

@@ -27,7 +27,7 @@ metadata hint — clients back off instead of hammering a saturated pool.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from .. import faults
 from ..analysis.locks import make_lock
@@ -123,6 +123,18 @@ class AdmissionController:
         )
         self._buckets: Dict[str, TokenBucket] = {}  #: guarded_by _lock
         self._lock = make_lock("admission")
+        # Degrade gate (autoscale ladder rung 3): requests below this
+        # priority floor shed with cause "degraded" while the pool digs
+        # out of an SLO burn. 0 = gate off. Flipped cross-thread by
+        # ReplicaPool.set_degrade_level — plain int store, no lock.
+        self.min_priority = 0
+        # Cold-start decode-rate seed: when no rate has been observed
+        # AND the operator set no AIOS_TPU_ASSUMED_TPS floor, the pool
+        # installs a callable deriving tokens/sec from the devprof
+        # ledger's per-graph step means (docs/RUNBOOK.md §8) — a stale
+        # hardcoded floor mis-sheds deadline requests on fast hardware.
+        # The env knob (cfg.assumed_tokens_per_sec > 0) always wins.
+        self.devprof_rate_fn: Optional[Callable[[], float]] = None
         # one closed enum end to end: the shed counter's label set, the
         # AdmissionError causes, and the flight recorder's shed events
         # all draw from obs.flightrec.SHED_CAUSES
@@ -136,6 +148,25 @@ class AdmissionController:
         """Count and build (not raise) the shed error for ``cause``."""
         self._obs_shed[cause].inc()
         return AdmissionError(message, cause, retry_after_ms, retriable)
+
+    # -- gate 0: degrade-ladder priority floor (clock-free, runs first) ----
+
+    def check_priority(self, priority: int) -> None:
+        """Autoscale ladder rung 3: shed best-effort traffic (priority
+        below the protected floor) while the controller is digging the
+        pool out of an SLO burn. Reactive/operational tiers (priority
+        >= 1) keep admitting — the preemption order the batcher's
+        priority-aware slot admission already enforces continues to
+        protect them once admitted."""
+        if self.min_priority <= 0 or priority >= self.min_priority:
+            return
+        raise self.shed(
+            "degraded",
+            f"pool degraded under SLO burn: best-effort traffic "
+            f"(priority {priority} < floor {self.min_priority}) is "
+            f"temporarily shed",
+            5000,
+        )
 
     # -- gate 3 (runs LAST — debiting is a side effect): tenant quota ------
 
@@ -207,7 +238,7 @@ class AdmissionController:
             # than they are, driving deadline sheds (and their
             # retry-after metadata) on demand
             deadline_s = deadline_s - act.skew_s
-        rate = rate_tps or self.cfg.assumed_tokens_per_sec
+        rate = rate_tps or self.assumed_rate()
         if rate <= 0:
             return  # no observed rate yet: cannot estimate, never shed
         need_s = (outstanding_tokens + max_tokens) / rate
@@ -219,6 +250,17 @@ class AdmissionController:
                 f"deadline",
                 self._drain_ms(outstanding_tokens, rate),
             )
+
+    def assumed_rate(self) -> float:
+        """Cold-start decode-rate floor for the feasibility gate: the
+        operator's AIOS_TPU_ASSUMED_TPS knob when set, else the
+        devprof-seeded estimate installed by the pool (0.0 when devprof
+        is unarmed or has no step samples yet — the gate then never
+        sheds, the pre-existing cold behavior)."""
+        if self.cfg.assumed_tokens_per_sec > 0:
+            return self.cfg.assumed_tokens_per_sec
+        fn = self.devprof_rate_fn
+        return float(fn() or 0.0) if fn is not None else 0.0
 
     @staticmethod
     def _drain_ms(outstanding_tokens: int, rate_tps: float) -> int:

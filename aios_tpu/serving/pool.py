@@ -115,6 +115,21 @@ class ReplicaPool:
                     pass
             raise
         self.restarts = 0  # spawner-style: batchers respawned after crash
+        # Degrade ladder position (serving/autoscale.py): 0 = healthy,
+        # 1 = speculation off, 2 = + jump-ahead off, 3 = + best-effort
+        # tiers shed at admission. Mechanism lives HERE (fresh batchers
+        # from crash-respawn or scale-up inherit the level); policy —
+        # when to move — lives in the controller. Plain int, flipped
+        # cross-thread by set_degrade_level.
+        self.degrade_level = 0
+        # set by an attached AutoscaleController; shutdown() stops it so
+        # an unload/hot-swap can never leave a controller scaling a
+        # drained pool
+        self.autoscaler = None
+        # cold-start deadline feasibility: seed the assumed decode rate
+        # from the devprof ledger's per-graph step means when devprof is
+        # armed (env knob wins — see AdmissionController.assumed_rate)
+        self.admission.devprof_rate_fn = self._devprof_rate
         # optional hook fired as on_respawn(replica_idx, new_batcher) —
         # ModelManager uses it to keep ManagedModel's replica-0 batcher
         # snapshot from going stale after a crash-respawn
@@ -138,7 +153,35 @@ class ReplicaPool:
         # serving-side queue-wait histogram: observed by the batcher at
         # slot assignment (see ContinuousBatcher.queue_wait_obs)
         b.queue_wait_obs = obs.SERVING_QUEUE_WAIT.labels(model=self.name)
+        # a batcher spawned mid-degrade (crash-respawn, scale-up)
+        # inherits the pool's current ladder position
+        level = getattr(self, "degrade_level", 0)
+        b.degrade_spec = level >= 1
+        b.degrade_jump = level >= 2
         return b
+
+    def _devprof_rate(self) -> float:
+        """Devprof-seeded cold-start decode rate: chunk_steps tokens per
+        decode dispatch over the ledger's mean sampled step seconds — a
+        conservative single-slot tokens/sec floor for the deadline
+        feasibility gate. 0.0 (gate stays cold-disabled) when devprof is
+        unarmed or has no step samples yet."""
+        from ..obs import devprof
+
+        reps = self.replicas
+        if not reps:
+            return 0.0
+        steps = getattr(reps[0].batcher, "chunk_steps", 0)
+        if steps <= 0:
+            return 0.0
+        means = [
+            m for m in (
+                led.mean_s("step") for led in devprof.ledgers_for(self.name)
+            ) if m
+        ]
+        if not means:
+            return 0.0
+        return steps / (sum(means) / len(means))
 
     def _register_gauges(self) -> None:
         ref = weakref.ref(self)
@@ -160,22 +203,30 @@ class ReplicaPool:
             lambda: obs.SERVING_REPLICAS.remove(model=self.name),
         ))
         for i in range(len(self.replicas)):
-            def occ(i=i):
-                p = ref()
-                if p is None or p._closed or i >= len(p.replicas):
-                    return 0.0
-                return p.replicas[i].occupancy()
+            self._bind_occupancy(i)
 
-            child = obs.SERVING_REPLICA_OCCUPANCY.labels(
+    def _bind_occupancy(self, i: int) -> None:
+        """Bind the per-index occupancy gauge (shared by construction
+        and autoscale add_replica; an index past the live list — a
+        scaled-down or crashed replica — reads 0.0)."""
+        ref = weakref.ref(self)
+
+        def occ(i=i):
+            p = ref()
+            if p is None or p._closed or i >= len(p.replicas):
+                return 0.0
+            return p.replicas[i].occupancy()
+
+        child = obs.SERVING_REPLICA_OCCUPANCY.labels(
+            model=self.name, replica=str(i)
+        )
+        child.set_function(occ)
+        self._gauge_bindings.append((
+            child, occ,
+            lambda i=i: obs.SERVING_REPLICA_OCCUPANCY.remove(
                 model=self.name, replica=str(i)
-            )
-            child.set_function(occ)
-            self._gauge_bindings.append((
-                child, occ,
-                lambda i=i: obs.SERVING_REPLICA_OCCUPANCY.remove(
-                    model=self.name, replica=str(i)
-                ),
-            ))
+            ),
+        ))
 
     # -- serving ------------------------------------------------------------
 
@@ -245,15 +296,18 @@ class ReplicaPool:
         if self._draining or self._closed:
             raise RuntimeError(f"model {self.name} is draining")
         self._respawn_dead()
+        # snapshot: a concurrent autoscale add/remove rebinding
+        # self.replicas must not tear index selection mid-route
+        reps = self.replicas
         route_ids, _ = self._route_ids(req)
         route_detail: Dict[str, int] = {}
-        if cause == "evicted" and len(self.replicas) > 1:
-            idx, reason = self.router.least_loaded(self.replicas), \
+        if cause == "evicted" and len(reps) > 1:
+            idx, reason = self.router.least_loaded(reps), \
                 "least_loaded"
         else:
-            hashes = self.replicas[0].prefix_hashes(route_ids)
+            hashes = reps[0].prefix_hashes(route_ids)
             idx, reason = self.router.select(
-                self.replicas, route_ids, req.request_id, hashes=hashes,
+                reps, route_ids, req.request_id, hashes=hashes,
                 detail=route_detail,
             )
         rec = getattr(req, "rec", None)
@@ -265,7 +319,7 @@ class ReplicaPool:
                 resumed_tokens=len(req.prompt_ids), **route_detail,
             )
         task_id = req.request_id
-        handle = self.replicas[idx].batcher.submit(req)
+        handle = reps[idx].batcher.submit(req)
         self._count_route(reason, task_id, idx)
         return handle
 
@@ -300,37 +354,44 @@ class ReplicaPool:
             raise self.admission.shed(
                 "draining", f"model {self.name} is draining", 2000
             )
+        # degrade ladder rung 3 (clock-free policy gate, before any
+        # routing work): best-effort tiers shed while the autoscaler digs
+        # the pool out of an SLO burn; priority >= 1 stays protected
+        self.admission.check_priority(getattr(req, "priority", 0))
         self._respawn_dead()
+        # snapshot: a concurrent autoscale add/remove rebinding
+        # self.replicas must not tear index selection mid-route
+        reps = self.replicas
         # hash the blocks ONCE; every replica's probe reuses the digests
         # (replicas share page size and truncation — see _route_ids)
         route_ids, cap = self._route_ids(req)
-        hashes = self.replicas[0].prefix_hashes(route_ids)
+        hashes = reps[0].prefix_hashes(route_ids)
         rec = getattr(req, "rec", None)
         route_detail: Dict[str, int] = {}
         idx, reason = self.router.select(
-            self.replicas, route_ids, req.request_id, hashes=hashes,
+            reps, route_ids, req.request_id, hashes=hashes,
             detail=route_detail,
         )
         if (
             self.cfg.max_queue > 0
-            and len(self.replicas) > 1
-            and self.replicas[idx].queue_depth() >= self.cfg.max_queue
+            and len(reps) > 1
+            and reps[idx].queue_depth() >= self.cfg.max_queue
         ):
             # spill: a full cache-preferred replica must not shed while a
             # sibling has queue room (losing the prefix hit beats a shed)
             # — least-loaded AMONG the replicas with room, not overall
             # (the global minimum can itself be full of small budgets)
             with_room = [
-                i for i, rep in enumerate(self.replicas)
+                i for i, rep in enumerate(reps)
                 if rep.queue_depth() < self.cfg.max_queue
             ]
             if with_room:
                 alt = min(
                     with_room,
-                    key=lambda i: self.replicas[i].outstanding_tokens(),
+                    key=lambda i: reps[i].outstanding_tokens(),
                 )
                 idx, reason = alt, "spill"
-        r = self.replicas[idx]
+        r = reps[idx]
         self.admission.check_queue(
             r.queue_depth(), r.outstanding_tokens(), r.tokens_per_second()
         )
@@ -405,6 +466,60 @@ class ReplicaPool:
                 if self.on_respawn is not None:
                     self.on_respawn(r.idx, r.batcher)
 
+    # -- elastic lifecycle (serving/autoscale.py drives these) --------------
+
+    def set_degrade_level(self, level: int) -> int:
+        """Move the degrade ladder: 0 healthy, 1 speculation off, 2 +
+        jump-ahead off, 3 + best-effort admission shed (priority < 1;
+        the reactive/operational tiers stay protected). Applies to every
+        live replica batcher and to admission; fresh batchers (respawn,
+        scale-up) inherit via _spawn_batcher. Greedy token streams are
+        pinned identical across any transition — both switched paths are
+        token-identical on/off by construction. Returns the clamped
+        level actually applied."""
+        level = max(0, min(int(level), 3))
+        self.degrade_level = level
+        for r in self.replicas:
+            r.batcher.degrade_spec = level >= 1
+            r.batcher.degrade_jump = level >= 2
+        self.admission.min_priority = 1 if level >= 3 else 0
+        return level
+
+    def add_replica(self, engine) -> int:
+        """Scale up: attach one more engine+batcher replica (the
+        autoscaler builds the engine OUTSIDE any pool lock — warmup
+        compiles take seconds). The new replica starts cold (no prefix
+        pages) so the router's least-loaded fallback naturally sends it
+        the overflow. Returns the new replica index."""
+        if self._closed or self._draining:
+            raise RuntimeError(f"model {self.name} is draining")
+        r = Replica(len(self.replicas), engine, self._spawn_batcher(engine))
+        # atomic list rebind: submit paths snapshot self.replicas once,
+        # so they see either the old or the new list, never a torn one
+        self.replicas = self.replicas + [r]
+        self._bind_occupancy(r.idx)
+        return r.idx
+
+    def remove_replica(self, drain_timeout: float = 30.0):
+        """Scale down: detach the LAST replica (sticky bindings past the
+        new length self-invalidate — Router._sticky_for clamps), drain
+        its in-flight streams, shut its batcher down, and return the
+        detached :class:`Replica` (the caller owns the engine and closes
+        it if it created it). Returns None when the pool is at one
+        replica — a pool never scales to zero."""
+        reps = self.replicas
+        if len(reps) <= 1 or self._closed:
+            return None
+        victim = reps[-1]
+        # unroute first (atomic rebind), then drain: new submissions can
+        # no longer land on the victim while its in-flight streams finish
+        self.replicas = reps[:-1]
+        deadline = time.monotonic() + drain_timeout
+        while time.monotonic() < deadline and not victim.idle():
+            time.sleep(0.02)
+        victim.batcher.shutdown()
+        return victim
+
     # -- lifecycle ----------------------------------------------------------
 
     def drain(self, timeout: float = 30.0) -> bool:
@@ -422,6 +537,10 @@ class ReplicaPool:
         """Shut every replica down (optionally draining first) and free
         engine HBM deterministically."""
         self._draining = True
+        # stop the attached autoscaler FIRST: a controller tick racing
+        # shutdown must not spawn a replica onto a draining pool
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if drain_timeout > 0:
             self.drain(drain_timeout)
         self._closed = True
@@ -444,6 +563,7 @@ class ReplicaPool:
         out: Dict[str, float] = {
             "replicas": len(self.replicas),
             "replica_restarts": self.restarts,
+            "degrade_level": self.degrade_level,
         }
         occ = []
         for r in self.replicas:

@@ -1054,6 +1054,18 @@ def bench_chaos(seed: int = 42) -> int:
     aborted = sum(v["aborted"] for v in arms.values())
     deterministic = all(v["deterministic"] for v in arms.values())
     complete = all(v["complete"] for v in arms.values())
+    # the schedule must have ARMED: an empty fired-fault journal (e.g. a
+    # mis-spelled point name surviving a refactor) would otherwise pass
+    # the whole gate vacuously — a storm that injected nothing proved
+    # nothing
+    armed = all(
+        v["a"]["faults_total"] > 0 and v["b"]["faults_total"] > 0
+        for v in arms.values()
+    )
+    if not armed:
+        log("[chaos] FAULT SCHEDULE NEVER FIRED — the storm ran "
+            "fault-free and the gate would have passed vacuously; check "
+            "the schedule's point names against faults.POINTS")
     # the draft arm's streams must ALSO match the plain arm's: greedy
     # speculation may change dispatch counts, never tokens — even with
     # a mid-storm crash and a failover-time draft-KV rebuild
@@ -1061,7 +1073,7 @@ def bench_chaos(seed: int = 42) -> int:
         arms["draft"]["a"]["streams"] == arms["plain"]["a"]["streams"]
     )
     ok = (stuck == 0 and aborted == 0 and complete and deterministic
-          and spec_identical)
+          and spec_identical and armed)
     pa, da = arms["plain"]["a"], arms["draft"]["a"]
     la = arms["longctx"]["a"]
     log(f"[chaos] seed={seed} restarts plain="
@@ -1099,8 +1111,321 @@ def bench_chaos(seed: int = 42) -> int:
         "deterministic": deterministic,
         "draft_streams_match_plain": spec_identical,
         "streams_complete": complete,
+        "faults_armed": armed,
     })
     return 0 if ok else 1
+
+
+def bench_storm(scenario_path: str = "", smoke: bool = False,
+                chaos_seed: int | None = None) -> int:
+    """Million-user storm gate (--storm): a seeded trace-driven tenant
+    mix (aios_tpu/loadgen/) drives the FULL gRPC surface — Infer +
+    StreamInfer through a live runtime service over a real replica pool
+    — twice, and the deterministic verdict (per-tenant counts, greedy
+    stream hashes, PASS against the scenario's declared SLO targets)
+    must be identical across the runs. Composes with --chaos: the same
+    storm runs under a seeded fault schedule (replica crash + dispatch
+    delays) and transparent failover must still complete every
+    deterministic stream.
+
+    Full mode (not --smoke) additionally proves the autoscaling closed
+    loop (serving/autoscale.py) on direct pools:
+
+      * induced overload -> the controller scales replicas to the
+        ceiling, then walks the degrade ladder (spec off -> jump off ->
+        shed best-effort) — with greedy token streams pinned identical
+        to an untouched control pool across every ladder transition;
+      * a healthy steady-state run leaves the controller provably
+        quiescent (zero actions).
+    """
+    import contextlib
+    import os as _os
+
+    from aios_tpu import faults
+    from aios_tpu.loadgen import (
+        StormDriver, build_report, build_trace, load_scenario,
+    )
+    from aios_tpu.obs import slo
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    from aios_tpu.loadgen.scenario import (
+        default_scenario_path, time_scale_env,
+    )
+
+    here = _os.path.dirname(_os.path.abspath(__file__))
+    if not scenario_path:
+        scenario_path = default_scenario_path(here, smoke)
+    sc = load_scenario(scenario_path)
+    trace = build_trace(sc)
+    time_scale = time_scale_env()
+    schedule = (
+        f"seed={chaos_seed};pool.scheduler_crash=nth:10;"
+        "dispatch.delay=prob:0.1,delay_ms=3"
+        if chaos_seed is not None else ""
+    )
+
+    @contextlib.contextmanager
+    def _env(**kv):
+        old = {k: _os.environ.get(k) for k in kv}
+        _os.environ.update({k: str(v) for k, v in kv.items()})
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+
+    def run_once(tag: str) -> dict:
+        # fresh windows per run: the SLO engine and recorder are
+        # process-global and the verdict reads the live /debug/slo
+        slo.ENGINE.clear()
+        plan = faults.activate(schedule) if schedule else None
+        server = service = manager = None
+        env = dict(
+            AIOS_TPU_REPLICAS=sc.replicas,
+            AIOS_TPU_PAGED_KV="auto",
+            AIOS_TPU_MAX_QUEUE=sc.max_queue,
+            AIOS_TPU_TENANT_TOKENS_PER_SEC=sc.tenant_tokens_per_sec,
+            AIOS_TPU_TENANT_BURST_TOKENS=sc.tenant_burst_tokens,
+        )
+        try:
+            with _env(**env):
+                manager = ModelManager(
+                    num_slots=sc.num_slots, warm_compile=False
+                )
+                manager.load_model(
+                    sc.model, "synthetic://tiny-test",
+                    context_length=sc.context,
+                )
+                server, service, port = serve(
+                    address="127.0.0.1:0", manager=manager, block=False,
+                    metrics_port=0,
+                )
+            driver = StormDriver(
+                f"127.0.0.1:{port}", sc.model,
+                metrics_port=service.metrics_port,
+                time_scale=time_scale,
+            )
+            try:
+                # prologue: prime compiles + a clean observed-rate
+                # window, so deadline feasibility judges run a (cold)
+                # and run b (warm) identically
+                driver.warmup()
+                outcomes = driver.run(trace)
+                surface = driver.slo_surface()
+            finally:
+                driver.close()
+            report = build_report(sc, trace, outcomes, surface)
+            report["faults_injected"] = (
+                len(plan.journal()) if plan is not None else None
+            )
+            pool = manager.models[sc.model].pool
+            report["measured"]["replica_restarts"] = pool.restarts
+            return report
+        finally:
+            try:
+                if server is not None:
+                    server.stop(grace=None)
+                if service is not None \
+                        and service.metrics_server is not None:
+                    service.metrics_server.shutdown()
+                if manager is not None:
+                    manager.unload_model(sc.model)
+            except Exception as e:  # noqa: BLE001 - teardown is best-effort
+                log(f"[storm] teardown issue ({tag}): {e!r}")
+            if plan is not None:
+                faults.deactivate()
+
+    a = run_once("a")
+    b = run_once("b")
+    deterministic = a["verdict"] == b["verdict"]
+    verdict_diff = None
+    if not deterministic:
+        # field-level diff so a FAIL names the diverging keys instead of
+        # dumping two whole verdicts at the operator
+        verdict_diff = {}
+        for k in set(a["verdict"]) | set(b["verdict"]):
+            va, vb = a["verdict"].get(k), b["verdict"].get(k)
+            if va != vb:
+                verdict_diff[k] = {"a": va, "b": vb}
+        log(f"[storm] NONDETERMINISTIC verdict keys: "
+            f"{sorted(verdict_diff)}")
+    chaos_armed = (
+        chaos_seed is None
+        or ((a["faults_injected"] or 0) > 0
+            and (b["faults_injected"] or 0) > 0)
+    )
+    ok = a["pass"] and b["pass"] and deterministic and chaos_armed
+    auto = None
+    if not smoke:
+        auto = _storm_autoscale_arms()
+        ok = ok and auto["ok"]
+    log(f"[storm] scenario={sc.name} seed={sc.seed} calls={len(trace)} "
+        f"pass_a={a['pass']} pass_b={b['pass']} "
+        f"deterministic={deterministic} chaos_armed={chaos_armed} "
+        + (f"autoscale_ok={auto['ok']} " if auto is not None else "")
+        + f"verdict={'PASS' if ok else 'FAIL'}")
+    emit({
+        "metric": "storm gate (seeded trace-driven tenant mix over the "
+                  "live gRPC surface, run twice"
+                  + (", under seeded faults" if chaos_seed is not None
+                     else "")
+                  + ("" if smoke else "; + autoscale closed-loop arms")
+                  + ")",
+        "value": 1.0 if ok else 0.0,
+        "unit": "verdict (1 = pass)",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "scenario": sc.name,
+        "scenario_path": _os.path.relpath(scenario_path, here),
+        "seed": sc.seed,
+        "calls": len(trace),
+        "deterministic": deterministic,
+        "chaos": chaos_seed,
+        "chaos_armed": chaos_armed,
+        "faults_injected": [a["faults_injected"], b["faults_injected"]],
+        "verdict_a": a["verdict"],
+        "verdict_diff": verdict_diff,
+        "measured_a": a["measured"],
+        "measured_b": b["measured"],
+        "autoscale": auto,
+    })
+    return 0 if ok else 1
+
+
+def _storm_autoscale_arms() -> dict:
+    """The closed-loop halves of the storm gate (full --storm mode):
+    induced overload must scale up then degrade (streams pinned
+    identical to a control pool across every ladder transition), and a
+    healthy run must leave the controller quiescent."""
+    import threading as _threading
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.obs import flightrec
+    from aios_tpu.obs.slo import SLOConfig, SLOEngine
+    from aios_tpu.serving import (
+        AutoscaleConfig, AutoscaleController, ReplicaPool, ServingConfig,
+    )
+
+    cfg = TINY_TEST.scaled(name="storm-auto", max_context=256)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+
+    def make_engine():
+        return TPUEngine(cfg, params, num_slots=4, max_context=256,
+                         cache_dtype=jnp.float32, track_history=True)
+
+    def make_pool(name):
+        return ReplicaPool(
+            name, [make_engine()],
+            lambda e: ContinuousBatcher(e, chunk_steps=2,
+                                        admit_chunk_steps=2,
+                                        speculative=True),
+            ServingConfig(replicas=1),
+        )
+
+    def wave(pool, n=6, max_tokens=48):
+        handles = [
+            pool.submit(Request(prompt_ids=[3 + i, 7, 11, 13], priority=1,
+                                max_tokens=max_tokens, temperature=0.0,
+                                request_id=f"auto-{i}"))
+            for i in range(n)
+        ]
+        return [h.tokens() for h in handles]
+
+    # control pool: the token-identity reference, untouched by any
+    # controller
+    control = make_pool("storm-auto")
+    control_streams = wave(control)
+    control.shutdown()
+
+    # overload arm: tight targets make real latencies burn hard; the
+    # controller must scale to the ceiling then walk the whole ladder
+    # WHILE a greedy wave is in flight (transitions land mid-stream)
+    tight = SLOEngine(SLOConfig(ttft_ms=0.01, tpot_ms=0.01, target=0.99,
+                                window_secs=600, min_samples=4))
+    pool = make_pool("storm-auto")
+    ctl = AutoscaleController(
+        pool,
+        AutoscaleConfig(max_replicas=2, hold_ticks=1, cooldown_secs=0.0,
+                        interval_secs=0.02),
+        engine_factory=make_engine, slo_engine=tight,
+    )
+    seed_streams = wave(pool, n=4, max_tokens=8)  # latency evidence
+    for tl in flightrec.RECORDER.recent(model="storm-auto", limit=64):
+        tight.observe(tl)
+    ticker_stop = _threading.Event()
+
+    def ticker():
+        while not ticker_stop.wait(0.02):
+            ctl.tick()
+
+    th = _threading.Thread(target=ticker, daemon=True)
+    th.start()
+    try:
+        overload_streams = wave(pool)
+        deadline = _time.monotonic() + 20
+        while _time.monotonic() < deadline and (
+            len(pool.replicas) < 2 or pool.degrade_level < 3
+        ):
+            _time.sleep(0.05)
+    finally:
+        ticker_stop.set()
+        th.join(timeout=5)
+    actions = ctl.actions()
+    scaled = any(a["action"] == "scale_up" for a in actions)
+    rungs = [a.get("rung") for a in actions if a["action"] == "degrade"]
+    ladder_complete = rungs[:3] == ["spec_off", "jump_off",
+                                   "shed_best_effort"]
+    # streams pinned across the transitions the ticker made mid-wave
+    post_streams = wave(pool)  # fully degraded: still token-identical
+    streams_ok = (
+        overload_streams == control_streams
+        and post_streams == control_streams
+    )
+    pool.shutdown()
+
+    # quiescent arm: the SAME real traffic against generous targets —
+    # the controller must take zero actions
+    calm = SLOEngine(SLOConfig(ttft_ms=60_000, tpot_ms=60_000,
+                               target=0.9, window_secs=600,
+                               min_samples=4))
+    pool2 = make_pool("storm-auto")
+    ctl2 = AutoscaleController(
+        pool2,
+        AutoscaleConfig(max_replicas=2, hold_ticks=1, cooldown_secs=0.0),
+        engine_factory=make_engine, slo_engine=calm,
+    )
+    wave(pool2, n=4, max_tokens=8)
+    for tl in flightrec.RECORDER.recent(model="storm-auto", limit=64):
+        calm.observe(tl)
+    for _ in range(10):
+        ctl2.tick()
+    quiescent = len(ctl2.actions()) == 0
+    pool2.shutdown()
+
+    ok = scaled and ladder_complete and streams_ok and quiescent
+    return {
+        "ok": ok,
+        "scale_up": scaled,
+        "ladder": rungs,
+        "ladder_complete": ladder_complete,
+        "streams_identical_across_transitions": streams_ok,
+        "quiescent_zero_actions": quiescent,
+        "actions": [
+            {k: a.get(k) for k in ("action", "cause", "level", "replicas")}
+            for a in actions
+        ],
+    }
 
 
 def bench_dispatch():
@@ -2026,7 +2351,38 @@ def main() -> int:
                          "(scripts/chaos.sh, docs/FAULTS.md)")
     ap.add_argument("--chaos-seed", type=int, default=42, metavar="N",
                     help="fault-schedule seed for --chaos (default 42)")
+    ap.add_argument("--storm", action="store_true",
+                    help="run ONLY the million-user storm gate: a seeded "
+                         "trace-driven tenant mix (aios_tpu/loadgen/) "
+                         "drives the live gRPC surface twice — exit "
+                         "NON-ZERO on a FAIL verdict or any "
+                         "deterministic-fingerprint divergence. Composes "
+                         "with --chaos (same storm under seeded faults). "
+                         "Full mode adds the autoscale closed-loop arms "
+                         "(scripts/preflight.sh, docs/TESTING.md)")
+    ap.add_argument("--storm-scenario", metavar="PATH", default="",
+                    help="scenario file for --storm (default: the "
+                         "committed scenarios/storm_reference.toml, or "
+                         "storm_smoke.toml with --smoke)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --storm: the small CI scenario, "
+                         "determinism pair only (no autoscale arms) — "
+                         "the preflight gate")
     args = ap.parse_args()
+
+    if args.storm:
+        try:
+            return bench_storm(
+                args.storm_scenario, smoke=args.smoke,
+                chaos_seed=args.chaos_seed if args.chaos else None,
+            )
+        except Exception as e:  # a crashed harness is a FAIL, loudly
+            log(f"[storm] HARNESS FAILED: {e!r}")
+            emit({"metric": "storm gate (seeded trace-driven tenant mix "
+                            "over the live gRPC surface, run twice)",
+                  "value": 0.0, "unit": "verdict (1 = pass)",
+                  "vs_baseline": 0.0, "error": repr(e)[:300]})
+            return 1
 
     if args.chaos:
         try:

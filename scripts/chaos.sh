@@ -28,6 +28,11 @@
 # snapshot (GET /debug/snapshots on a live deployment, or the
 # AIOS_TPU_FLIGHTREC_DUMP_DIR files) holds the per-request timelines.
 # docs/RUNBOOK.md "chaos drill" walks the live-pool version.
+#
+# The gate also fails LOUDLY when the fault schedule never fired
+# (faults_armed=false in the JSON): an empty faults.fired() journal —
+# e.g. a point name mis-spelled during a refactor — used to let the
+# storm pass vacuously, proving nothing.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -18,7 +18,16 @@
 #                                  dispatch-count or device-time
 #                                  regression past the budget fails the
 #                                  gate (docs/OBSERVABILITY.md
-#                                  "Device-time attribution").
+#                                  "Device-time attribution");
+#   5. the storm smoke           — bench.py --storm --smoke: the seeded
+#                                  trace-driven tenant mix (streaming
+#                                  chat + fork-shaped agent families +
+#                                  a quota storm) drives the live gRPC
+#                                  surface twice and the deterministic
+#                                  verdict must be identical and PASS
+#                                  (aios_tpu/loadgen/, docs/TESTING.md)
+#                                  — every PR is gated under
+#                                  contention-realistic load.
 #
 # The devprof threshold here is looser than benchdiff's default: the
 # committed baseline was captured on a different run of a noisy shared-
@@ -36,19 +45,23 @@ threshold="${PREFLIGHT_DEVPROF_THRESHOLD:-0.75}"
 workdir="$(mktemp -d)"
 trap 'rm -rf "$workdir"' EXIT
 
-echo "[preflight 1/4] static analysis (scripts/analyze.sh)" >&2
+echo "[preflight 1/5] static analysis (scripts/analyze.sh)" >&2
 scripts/analyze.sh
 
-echo "[preflight 2/4] obs-lint subset (tests/test_obs_lint.py)" >&2
+echo "[preflight 2/5] obs-lint subset (tests/test_obs_lint.py)" >&2
 python -m pytest tests/test_obs_lint.py -q -p no:cacheprovider
 
-echo "[preflight 3/4] seeded chaos storm (bench.py --chaos)" >&2
+echo "[preflight 3/5] seeded chaos storm (bench.py --chaos)" >&2
 python bench.py --chaos > "$workdir/chaos.json"
 
-echo "[preflight 4/4] devprof sentinel (bench.py --devprof vs" \
+echo "[preflight 4/5] devprof sentinel (bench.py --devprof vs" \
      "BASELINE_DEVPROF.json, threshold +${threshold})" >&2
 python bench.py --devprof > "$workdir/devprof.json"
 python scripts/benchdiff.py BASELINE_DEVPROF.json \
     "$workdir/devprof.json" --threshold "$threshold"
+
+echo "[preflight 5/5] storm smoke (bench.py --storm --smoke," \
+     "seeded, run twice, deterministic verdict)" >&2
+python bench.py --storm --smoke > "$workdir/storm.json"
 
 echo "[preflight] PASS" >&2

@@ -1,0 +1,432 @@
+"""SLO-burn autoscaler: control law, ladder mechanics, token identity.
+
+The controller (serving/autoscale.py) is driven through tick() with
+injected clocks and a private SLOEngine, over REAL tiny pools — module-
+scoped params keep the engine builds cheap. The satellites ride along:
+the degraded-admission priority floor, the devprof-seeded assumed-TPS
+cold-start rate, and the /livez-vs-/healthz split under controller
+action.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aios_tpu.engine import model as model_mod
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.obs import flightrec
+from aios_tpu.obs.http import start_metrics_server
+from aios_tpu.obs.slo import SLOConfig, SLOEngine, annotate_health
+from aios_tpu.serving import (
+    AdmissionController,
+    AdmissionError,
+    AutoscaleConfig,
+    AutoscaleController,
+    ReplicaPool,
+    ServingConfig,
+)
+from aios_tpu.serving.autoscale import ACTIONS, CAUSES, LADDER
+
+CFG = TINY_TEST.scaled(name="autoscale-test", max_context=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model_mod.init_params(CFG, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+
+
+def make_engine(params, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return TPUEngine(CFG, params, **kw)
+
+
+def make_pool(params, name="autoscale-test", speculative=False, **ekw):
+    return ReplicaPool(
+        name, [make_engine(params, **ekw)],
+        lambda e: ContinuousBatcher(e, chunk_steps=2, admit_chunk_steps=2,
+                                    speculative=speculative),
+        ServingConfig(replicas=1),
+    )
+
+
+def tight_slo():
+    """Targets real CPU latencies always miss -> burn far above 1."""
+    return SLOEngine(SLOConfig(ttft_ms=0.001, tpot_ms=0.001, target=0.99,
+                               window_secs=30.0, min_samples=4))
+
+
+def calm_slo():
+    return SLOEngine(SLOConfig(ttft_ms=60_000, tpot_ms=60_000, target=0.9,
+                               window_secs=30.0, min_samples=4))
+
+
+def feed(slo, model, *, bad: bool, n=8, now=None):
+    ms = 100.0 if bad else 0.0001
+    for _ in range(n):
+        slo.record(model, ttft_ms=ms, tpot_ms=ms, ok=True, now=now)
+
+
+def controller(pool, slo, factory=None, **over):
+    kw = dict(max_replicas=2, hold_ticks=1, cooldown_secs=0.0)
+    kw.update(over)
+    return AutoscaleController(pool, AutoscaleConfig(**kw),
+                               engine_factory=factory, slo_engine=slo)
+
+
+# ---------------------------------------------------------------------------
+# control law
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_then_full_ladder_then_recovery(params):
+    """The acceptance arc in one run: burn scales to the ceiling, then
+    walks the ladder in declared order; recovery walks it back BEFORE
+    giving the replica up, and the journal records every action with a
+    closed-enum (action, cause)."""
+    pool = make_pool(params)
+    slo = tight_slo()
+    ctl = controller(pool, slo, factory=lambda: make_engine(params))
+    try:
+        t0 = time.monotonic()
+        feed(slo, pool.name, bad=True, now=t0)
+        assert ctl.tick(now=t0) == "scale_up"
+        assert len(pool.replicas) == 2
+        # replica added mid-degrade inherits level (none yet) and serves
+        for i, expect in enumerate(("degrade",) * 3):
+            assert ctl.tick(now=t0 + 0.1 * (i + 1)) == expect
+        assert pool.degrade_level == 3
+        assert ctl.tick(now=t0 + 1.0) == "saturated"
+        rungs = [a["rung"] for a in ctl.actions()
+                 if a["action"] == "degrade"]
+        assert rungs == list(LADDER)
+        # every batcher carries the switches; admission carries the floor
+        for r in pool.replicas:
+            assert r.batcher.degrade_spec and r.batcher.degrade_jump
+        assert pool.admission.min_priority == 1
+        # recovery: bad samples age out of the window, good ones land
+        t1 = t0 + 120.0
+        feed(slo, pool.name, bad=False, now=t1)
+        seq = [ctl.tick(now=t1 + 0.1 * i) for i in range(5)]
+        assert seq == ["restore", "restore", "restore", "scale_down",
+                       "steady"]
+        assert pool.degrade_level == 0 and len(pool.replicas) == 1
+        assert pool.admission.min_priority == 0
+        for a in ctl.actions():
+            assert a["action"] in ACTIONS and a["cause"] in CAUSES
+    finally:
+        pool.shutdown()
+
+
+def test_no_factory_degrades_without_scaling(params):
+    pool = make_pool(params)
+    slo = tight_slo()
+    ctl = controller(pool, slo, factory=None)
+    try:
+        t0 = time.monotonic()
+        feed(slo, pool.name, bad=True, now=t0)
+        assert ctl.tick(now=t0) == "degrade"
+        assert len(pool.replicas) == 1 and pool.degrade_level == 1
+        assert ctl.actions()[0]["cause"] == "burn"  # not at a ceiling
+    finally:
+        pool.shutdown()
+
+
+def test_hysteresis_hold_ticks_and_cooldown(params):
+    pool = make_pool(params)
+    slo = tight_slo()
+    ctl = controller(pool, slo, hold_ticks=2, cooldown_secs=5.0)
+    try:
+        t0 = time.monotonic()
+        feed(slo, pool.name, bad=True, now=t0)
+        assert ctl.tick(now=t0) == "hold"  # 1 of 2
+        assert ctl.tick(now=t0 + 0.1) == "degrade"  # 2 of 2
+        # next escalation wants 2 fresh holds AND the cooldown
+        assert ctl.tick(now=t0 + 0.2) == "hold"
+        assert ctl.tick(now=t0 + 0.3) == "cooldown"
+        assert pool.degrade_level == 1  # no flap
+        # past the cooldown (samples still in the window): acts again
+        assert ctl.tick(now=t0 + 6.0) == "degrade"
+    finally:
+        pool.shutdown()
+
+
+def test_quiescent_on_healthy_and_on_empty_window(params):
+    """Zero actions on a healthy run — the acceptance's quiescence
+    line — and zero on a cold pool (no evaluable window)."""
+    pool = make_pool(params)
+    slo = calm_slo()
+    ctl = controller(pool, slo, factory=lambda: make_engine(params))
+    try:
+        assert ctl.tick() == "idle"  # no samples at all
+        t0 = time.monotonic()
+        slo.record(pool.name, ttft_ms=5.0, tpot_ms=5.0, ok=True, now=t0)
+        assert ctl.tick(now=t0) == "idle"  # below min_samples
+        feed(slo, pool.name, bad=False, now=t0)
+        for i in range(6):
+            assert ctl.tick(now=t0 + 0.1 * i) in ("hold", "steady")
+        assert ctl.actions() == []
+    finally:
+        pool.shutdown()
+
+
+def test_kill_switch_restores_and_freezes(params, monkeypatch):
+    pool = make_pool(params)
+    slo = tight_slo()
+    ctl = controller(pool, slo)
+    try:
+        t0 = time.monotonic()
+        feed(slo, pool.name, bad=True, now=t0)
+        ctl.tick(now=t0)
+        ctl.tick(now=t0 + 0.1)
+        assert pool.degrade_level == 2
+        monkeypatch.setenv("AIOS_TPU_AUTOSCALE_KILL", "1")
+        assert ctl.tick(now=t0 + 0.2) == "kill"
+        assert pool.degrade_level == 0  # restored
+        assert ctl.actions()[-1]["cause"] == "kill_switch"
+        n = len(ctl.actions())
+        assert ctl.tick(now=t0 + 0.3) == "kill"  # frozen, no new action
+        assert len(ctl.actions()) == n
+        monkeypatch.delenv("AIOS_TPU_AUTOSCALE_KILL")
+        assert ctl.tick(now=t0 + 0.4) in ("hold", "degrade")  # live again
+    finally:
+        pool.shutdown()
+
+
+def test_autoscale_metric_children_and_model_event(params):
+    from aios_tpu.obs import instruments as obs
+
+    pool = make_pool(params)
+    slo = tight_slo()
+    ctl = controller(pool, slo)
+    try:
+        t0 = time.monotonic()
+        feed(slo, pool.name, bad=True, now=t0)
+        before = obs.AUTOSCALE_ACTIONS.labels(
+            model=pool.name, action="degrade", cause="burn"
+        ).value
+        ctl.tick(now=t0)
+        after = obs.AUTOSCALE_ACTIONS.labels(
+            model=pool.name, action="degrade", cause="burn"
+        ).value
+        assert after == before + 1
+        kinds = [k for _, m, k, _ in flightrec.RECORDER.model_events(
+            pool.name)]
+        assert "autoscale" in kinds
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pool mechanics: elastic replicas + degraded admission
+# ---------------------------------------------------------------------------
+
+
+def test_add_remove_replica_serve_and_drain(params):
+    pool = make_pool(params)
+    try:
+        idx = pool.add_replica(make_engine(params))
+        assert idx == 1 and len(pool.replicas) == 2
+        # both replicas serve; new batcher inherits pool-level hooks
+        hs = [pool.submit(Request(prompt_ids=[5 + i, 3], max_tokens=4,
+                                  temperature=0.0,
+                                  request_id=f"ar-{i}"))
+              for i in range(4)]
+        assert all(len(h.tokens()) == 4 for h in hs)
+        victim = pool.remove_replica()
+        assert victim is not None and len(pool.replicas) == 1
+        assert victim.batcher._closed  # drained + shut down
+        victim.engine.close()
+        # pool still serves after the shrink
+        h = pool.submit(Request(prompt_ids=[9, 3], max_tokens=3,
+                                temperature=0.0))
+        assert len(h.tokens()) == 3
+        assert pool.remove_replica() is None  # never below one
+    finally:
+        pool.shutdown()
+
+
+def test_degraded_admission_sheds_best_effort_protects_reactive(params):
+    pool = make_pool(params)
+    try:
+        pool.set_degrade_level(3)
+        with pytest.raises(AdmissionError) as err:
+            pool.submit(Request(prompt_ids=[1, 2], max_tokens=2,
+                                temperature=0.0, priority=0))
+        assert err.value.cause == "degraded"
+        assert err.value.retry_after_ms > 0
+        # the reactive/operational tier (priority >= 1) keeps admitting
+        h = pool.submit(Request(prompt_ids=[1, 2], max_tokens=2,
+                                temperature=0.0, priority=1))
+        assert len(h.tokens()) == 2
+        assert pool.stats()["shed_degraded"] == 1
+        pool.set_degrade_level(0)
+        h = pool.submit(Request(prompt_ids=[1, 3], max_tokens=2,
+                                temperature=0.0, priority=0))
+        assert len(h.tokens()) == 2
+    finally:
+        pool.shutdown()
+
+
+def test_spawned_batcher_inherits_degrade_level(params):
+    pool = make_pool(params)
+    try:
+        pool.set_degrade_level(2)
+        idx = pool.add_replica(make_engine(params))
+        b = pool.replicas[idx].batcher
+        assert b.degrade_spec and b.degrade_jump
+    finally:
+        pool.shutdown()
+
+
+def test_streams_token_identical_across_ladder_transitions(params):
+    """The acceptance's stream-identity line: a greedy wave decoded
+    while the pool walks 0 -> 1 -> 2 -> 3 mid-stream matches a wave on
+    an untouched control pool (speculative batchers, so rung 1 flips a
+    real mechanism)."""
+    prompts = [[3 + i, 7, 11, 13] for i in range(4)]
+
+    def wave(pool, degrade=False):
+        hs = [pool.submit(Request(prompt_ids=p, max_tokens=24,
+                                  temperature=0.0, priority=1,
+                                  request_id=f"ladder-{i}"))
+              for i, p in enumerate(prompts)]
+        if degrade:
+            for lvl in (1, 2, 3):
+                time.sleep(0.02)  # transitions land mid-decode
+                pool.set_degrade_level(lvl)
+        return [h.tokens() for h in hs]
+
+    control = make_pool(params, speculative=True)
+    try:
+        expect = wave(control)
+    finally:
+        control.shutdown()
+    pool = make_pool(params, speculative=True)
+    try:
+        got = wave(pool, degrade=True)
+        assert got == expect
+        assert pool.degrade_level == 3
+        # and back down, still identical
+        pool.set_degrade_level(0)
+        assert wave(pool) == expect
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: devprof-seeded assumed-TPS cold-start floor
+# ---------------------------------------------------------------------------
+
+
+def test_assumed_rate_env_knob_wins_over_devprof_seed():
+    adm = AdmissionController(
+        ServingConfig(assumed_tokens_per_sec=50.0), "ats-env"
+    )
+    adm.devprof_rate_fn = lambda: 999.0
+    assert adm.assumed_rate() == 50.0  # the knob wins when set
+    adm2 = AdmissionController(ServingConfig(), "ats-seed")
+    adm2.devprof_rate_fn = lambda: 200.0
+    assert adm2.assumed_rate() == 200.0  # devprof seeds the cold floor
+    adm3 = AdmissionController(ServingConfig(), "ats-cold")
+    assert adm3.assumed_rate() == 0.0  # nothing: gate stays cold-off
+
+
+def test_devprof_seed_drives_cold_deadline_gate():
+    """With a devprof-seeded rate, the feasibility gate sheds an
+    infeasible request even before any rate was observed (the stale
+    hardcoded floor this satellite replaces would have mis-judged)."""
+    adm = AdmissionController(ServingConfig(), "ats-gate")
+    adm.devprof_rate_fn = lambda: 10.0
+    with pytest.raises(AdmissionError) as err:
+        adm.check_deadline(1.0, 400, 100, 0.0)  # 500 tok at 10/s >> 1 s
+    assert err.value.cause == "deadline"
+    adm.check_deadline(120.0, 400, 100, 0.0)  # feasible at the seed
+
+
+def test_pool_devprof_rate_reads_step_ledger(params):
+    from aios_tpu.obs.devprof import DevprofLedger
+
+    pool = make_pool(params, name="ats-pool")
+    try:
+        assert pool._devprof_rate() == 0.0  # unarmed: no ledgers
+        led = DevprofLedger("ats-pool", device_kind="", sample_n=1)
+        led.note("step", None)
+        led.sample("step", None, 0.05)  # 50 ms per step-dispatch
+        steps = pool.replicas[0].batcher.chunk_steps
+        assert pool._devprof_rate() == pytest.approx(steps / 0.05)
+        # and it is wired as the admission fallback
+        assert pool.admission.assumed_rate() == pytest.approx(
+            steps / 0.05
+        )
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: /livez vs /healthz under controller action
+# ---------------------------------------------------------------------------
+
+
+def test_livez_stays_200_while_controller_degrades_and_healthz_503s(
+    params,
+):
+    """Restart probes must never kill a warmed process because the
+    autoscaler is mid-mitigation: /livez answers 200 through breach +
+    every ladder transition while /healthz flips to 503 (LB rotation),
+    and the pool keeps serving protected traffic throughout."""
+    model = "livez-ctl"
+    slo = SLOEngine(SLOConfig(ttft_ms=0.001, tpot_ms=0.001, target=0.99,
+                              window_secs=30.0, min_samples=4))
+    pool = make_pool(params, name=model)
+    ctl = controller(pool, slo)
+    def health_fn():
+        breached = [
+            m for m in slo.models()
+            if any(o["breached"] for o in slo.evaluate(m).values())
+        ]
+        payload = {"status": "ok", "service": "runtime"}
+        if breached:
+            payload["status"] = "degraded"
+            payload["slo_breached"] = breached
+        return payload
+
+    server, port = start_metrics_server(port=0, health_fn=health_fn)
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    try:
+        assert get("/livez")[0] == 200
+        assert get("/healthz")[0] == 200
+        t0 = time.monotonic()
+        feed(slo, model, bad=True, now=t0)
+        for i in range(3):  # breach -> controller walks the ladder
+            ctl.tick(now=t0 + 0.1 * i)
+        assert pool.degrade_level == 3
+        code, body = get("/healthz")
+        assert code == 503 and model in body["slo_breached"]
+        # liveness is UNTOUCHED by breach or degrade — and the warmed
+        # process demonstrably survives: it still serves protected work
+        assert get("/livez")[0] == 200
+        h = pool.submit(Request(prompt_ids=[2, 4], max_tokens=3,
+                                temperature=0.0, priority=1))
+        assert len(h.tokens()) == 3
+    finally:
+        server.shutdown()
+        pool.shutdown()

@@ -1,0 +1,328 @@
+"""Storm load generation: scenario validation, trace determinism, the
+verdict contract, and a scaled-down live-gRPC storm e2e.
+
+The trace builder is a pure function of (scenario, seed) — the storm
+gate's determinism contract rests on that, so it is pinned here at unit
+level; bench.py --storm (scripts/preflight.sh) pins the full twice-run
+verdict equality over the live service.
+"""
+
+import os
+
+import pytest
+
+from aios_tpu.loadgen import (
+    Outcome,
+    StormDriver,
+    build_report,
+    build_trace,
+    load_scenario,
+    trace_fingerprint,
+)
+from aios_tpu.loadgen.scenario import (
+    SLOTargets,
+    StormScenario,
+    TenantSpec,
+)
+
+SCENARIOS = os.path.join(os.path.dirname(__file__), "..", "scenarios")
+
+
+def scenario(**over):
+    base = dict(
+        name="unit", seed=11, duration_secs=4.0, model="loadgen-unit",
+        tenants=(
+            TenantSpec(name="chat", klass="interactive", rps=2.0,
+                       streaming=True),
+            TenantSpec(name="agents", klass="agent", rps=1.0,
+                       shared_prefix=80, fork_width=2),
+            TenantSpec(name="bulk", klass="batch", rps=1.0,
+                       arrival="diurnal", peak_ratio=4.0,
+                       period_secs=2.0),
+            TenantSpec(name="storm", klass="abusive", rps=6.0,
+                       arrival="burst", peak_ratio=6.0, period_secs=2.0,
+                       burst_secs=0.5, prompt_p50=100, max_tokens=40,
+                       quota_storm=True),
+            TenantSpec(name="probe", klass="reactive", rps=0.5,
+                       arrival="uniform", deadline_ms=60_000),
+        ),
+    )
+    base.update(over)
+    return StormScenario(**base)
+
+
+# ---------------------------------------------------------------------------
+# scenario spec
+# ---------------------------------------------------------------------------
+
+
+def test_committed_scenarios_load_and_validate():
+    for fname in ("storm_reference.toml", "storm_smoke.toml"):
+        sc = load_scenario(os.path.join(SCENARIOS, fname))
+        assert sc.tenants and sc.duration_secs > 0
+        assert sc.slo.attainment <= 1.0
+        classes = {t.klass for t in sc.tenants}
+        # the reference mix must keep exercising the interesting paths
+        assert "abusive" in classes and "agent" in classes
+
+
+def test_scenario_validation_fails_loudly(tmp_path):
+    bad = tmp_path / "bad.toml"
+    bad.write_text(
+        "[scenario]\nname='x'\n[[tenants]]\nname='t'\nclass='nope'\n"
+    )
+    with pytest.raises(ValueError, match="unknown class"):
+        load_scenario(str(bad))
+    empty = tmp_path / "empty.toml"
+    empty.write_text("[scenario]\nname='x'\n")
+    with pytest.raises(ValueError, match="at least one"):
+        load_scenario(str(empty))
+    dup = tmp_path / "dup.toml"
+    dup.write_text(
+        "[scenario]\nname='x'\n"
+        "[[tenants]]\nname='t'\n[[tenants]]\nname='t'\n"
+    )
+    with pytest.raises(ValueError, match="duplicate"):
+        load_scenario(str(dup))
+    unknown_key = tmp_path / "k.toml"
+    unknown_key.write_text(
+        "[scenario]\nname='x'\n[[tenants]]\nname='t'\nrsp=3\n"
+    )
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_scenario(str(unknown_key))
+
+
+# ---------------------------------------------------------------------------
+# trace builder
+# ---------------------------------------------------------------------------
+
+
+def test_trace_is_deterministic_and_seed_sensitive():
+    sc = scenario()
+    a, b = build_trace(sc), build_trace(sc)
+    assert a == b
+    assert trace_fingerprint(a) == trace_fingerprint(b)
+    c = build_trace(scenario(seed=12))
+    assert trace_fingerprint(a) != trace_fingerprint(c)
+
+
+def test_trace_tenant_independence():
+    """Adding a tenant never perturbs another tenant's schedule (each
+    draws from its own (seed, name) stream)."""
+    sc = scenario()
+    solo = StormScenario(
+        name="unit", seed=11, duration_secs=4.0, model="loadgen-unit",
+        tenants=(sc.tenant("chat"),),
+    )
+    full_chat = [c for c in build_trace(sc) if c.tenant == "chat"]
+    assert [c for c in build_trace(solo)] == full_chat
+
+
+def test_arrivals_sorted_and_inside_duration():
+    sc = scenario()
+    calls = build_trace(sc)
+    ts = [c.t for c in calls]
+    assert ts == sorted(ts)
+    roots = [c for c in calls if not c.parent]
+    assert all(0 <= c.t < sc.duration_secs for c in roots)
+
+
+def test_burst_curve_concentrates_arrivals():
+    sc = scenario(tenants=(
+        TenantSpec(name="b", klass="abusive", rps=4.0, arrival="burst",
+                   peak_ratio=10.0, period_secs=2.0, burst_secs=0.5,
+                   quota_storm=True),
+    ), duration_secs=8.0)
+    calls = build_trace(sc)
+    in_burst = sum(1 for c in calls if (c.t % 2.0) < 0.5)
+    # the on-window is 25% of the cycle at 10x rate: expect the large
+    # majority of arrivals inside it
+    assert in_burst / len(calls) > 0.6
+
+
+def test_fork_children_share_parent_prefix_and_pin_nothing():
+    calls = build_trace(scenario())
+    parents = {c.task_id: c for c in calls if not c.parent}
+    kids = [c for c in calls if c.parent]
+    assert kids, "agent tenant must fork"
+    for k in kids:
+        p = parents[k.parent]
+        assert k.prompt.startswith(p.prompt)  # the radix workload
+        assert k.t > p.t
+        assert not k.hash_stream  # cache-coupled: counts, not content
+        assert k.must_complete
+
+
+def test_quota_storm_calls_fixed_cost_and_excluded():
+    calls = [c for c in build_trace(scenario()) if c.klass == "abusive"]
+    assert len({(len(c.prompt), c.max_tokens) for c in calls}) == 1
+    assert all(not c.must_complete and not c.hash_stream for c in calls)
+
+
+def test_deadline_calls_excluded_from_determinism():
+    calls = [c for c in build_trace(scenario()) if c.deadline_ms > 0]
+    assert calls
+    assert all(not c.must_complete and not c.hash_stream for c in calls)
+
+
+def test_long_tail_prompt_lengths():
+    sc = scenario(tenants=(
+        TenantSpec(name="t", rps=20.0, prompt_p50=50, prompt_sigma=0.8,
+                   prompt_max=400),
+    ), duration_secs=10.0)
+    lens = [len(c.prompt) for c in build_trace(sc)]
+    med = sorted(lens)[len(lens) // 2]
+    assert 30 <= med <= 110  # around the p50
+    assert max(lens) > 2 * med  # a real tail
+    assert max(lens) <= 400  # capped
+
+
+# ---------------------------------------------------------------------------
+# verdict contract
+# ---------------------------------------------------------------------------
+
+
+def _outcome(c, status="ok", shed_cause="", text="tok tok", ttft=5.0,
+             chunks=3, wall=50.0):
+    return Outcome(call=c, status=status, shed_cause=shed_cause,
+                   text=text, ttft_ms=ttft, chunks=chunks, wall_ms=wall)
+
+
+def test_report_pass_and_deterministic_fields():
+    sc = scenario()
+    calls = build_trace(sc)
+    outcomes = [
+        _outcome(c) if c.must_complete or c.deadline_ms
+        else _outcome(c, status="shed", shed_cause="quota", text="")
+        for c in calls
+    ]
+    rep = build_report(sc, calls, outcomes, {"live": True})
+    assert rep["pass"] and rep["verdict"]["pass"]
+    v = rep["verdict"]
+    assert v["trace_sha"] == trace_fingerprint(calls)
+    # deadline tenants live in measured, not the deterministic verdict
+    assert "probe" not in v["tenants"]
+    assert "probe" in rep["measured"]["deadline_tenants"]
+    # hashes cover exactly the hash_stream calls
+    assert len(v["stream_hashes"]) == sum(
+        1 for c in calls if c.hash_stream
+    )
+    # identical outcomes -> identical verdict (the == the bench uses)
+    rep2 = build_report(sc, calls, list(outcomes), {"other": "surface"})
+    assert rep2["verdict"] == v  # the live surface is measured-only
+
+
+def test_report_fails_on_missing_deterministic_stream():
+    sc = scenario()
+    calls = build_trace(sc)
+    outcomes = [_outcome(c) for c in calls]
+    victim = next(o for o in outcomes if o.call.must_complete)
+    victim.status, victim.shed_cause = "shed", "queue_full"
+    rep = build_report(sc, calls, outcomes, {})
+    assert not rep["pass"]
+    assert victim.call.task_id in rep["verdict"]["deterministic_missing"]
+
+
+def test_report_fails_on_attainment_miss_and_errors():
+    sc = scenario(slo=SLOTargets(ttft_ms=1.0, attainment=0.99))
+    calls = build_trace(sc)
+    outcomes = [_outcome(c, ttft=500.0) for c in calls]
+    rep = build_report(sc, calls, outcomes, {})
+    assert not rep["pass"]  # every ttft over the 1 ms target
+    sc2 = scenario()
+    outcomes2 = [_outcome(c) for c in calls]
+    outcomes2[0].status, outcomes2[0].detail = "error", "boom"
+    rep2 = build_report(sc2, calls, outcomes2, {})
+    assert not rep2["pass"] and rep2["verdict"]["errors"] == 1
+
+
+def test_availability_excludes_quota_and_deadline_sheds():
+    sc = scenario()
+    calls = build_trace(sc)
+    outcomes = []
+    for c in calls:
+        if c.klass == "abusive":
+            outcomes.append(_outcome(c, status="shed",
+                                     shed_cause="quota", text=""))
+        elif c.deadline_ms:
+            outcomes.append(_outcome(c, status="shed",
+                                     shed_cause="deadline", text=""))
+        else:
+            outcomes.append(_outcome(c))
+    rep = build_report(sc, calls, outcomes, {})
+    # the plane failed nothing it owed: policy + feasibility refusals
+    assert rep["measured"]["availability"] == 1.0
+    assert rep["pass"]
+
+
+# ---------------------------------------------------------------------------
+# live e2e (scaled down; bench.py --storm is the full gate)
+# ---------------------------------------------------------------------------
+
+
+def test_mini_storm_over_live_grpc(monkeypatch):
+    """A tiny trace through the REAL service surface: streams complete,
+    tenant counts land, the quota storm sheds with retry-after, and the
+    verdict passes."""
+    from aios_tpu.obs import slo as slo_mod
+    from aios_tpu.runtime.model_manager import ModelManager
+    from aios_tpu.runtime.service import serve
+
+    sc = StormScenario(
+        name="mini", seed=3, duration_secs=1.2, model="storm-mini",
+        replicas=1, context=256, num_slots=2,
+        tenant_tokens_per_sec=1.0, tenant_burst_tokens=300.0,
+        tenants=(
+            TenantSpec(name="chat", klass="interactive", rps=2.5,
+                       prompt_p50=30, prompt_max=60, max_tokens=6,
+                       streaming=True),
+            TenantSpec(name="storm", klass="abusive", rps=5.0,
+                       arrival="burst", peak_ratio=4.0,
+                       period_secs=1.0, burst_secs=0.4,
+                       prompt_p50=100, max_tokens=60,
+                       quota_storm=True),
+        ),
+    )
+    monkeypatch.setenv("AIOS_TPU_TENANT_TOKENS_PER_SEC",
+                       str(sc.tenant_tokens_per_sec))
+    monkeypatch.setenv("AIOS_TPU_TENANT_BURST_TOKENS",
+                       str(sc.tenant_burst_tokens))
+    manager = ModelManager(num_slots=sc.num_slots, warm_compile=False)
+    server = service = None
+    try:
+        manager.load_model(sc.model, "synthetic://tiny-test",
+                           context_length=sc.context)
+        server, service, port = serve(
+            address="127.0.0.1:0", manager=manager, block=False,
+            metrics_port=0,
+        )
+        driver = StormDriver(f"127.0.0.1:{port}", sc.model,
+                             metrics_port=service.metrics_port)
+        try:
+            driver.warmup(n=1)
+            calls = build_trace(sc)
+            outcomes = driver.run(calls, join_timeout=120)
+            surface = driver.slo_surface()
+        finally:
+            driver.close()
+        rep = build_report(sc, calls, outcomes, surface)
+        assert rep["verdict"]["stuck"] == 0
+        assert rep["verdict"]["errors"] == 0
+        v = rep["verdict"]["tenants"]
+        assert v["chat"]["completed"] == v["chat"]["submitted"]
+        # the storm overran its bucket: sheds happened, with the
+        # retry-after hint the contract promises
+        assert v["storm"]["shed"] > 0
+        shed = [o for o in outcomes if o.status == "shed"]
+        assert all(o.shed_cause == "quota" for o in shed)
+        assert any(o.retry_after_ms > 0 for o in shed)
+        # the live /debug/slo surface saw the storm's model
+        assert sc.model in surface.get("models", {})
+        assert rep["pass"]
+    finally:
+        if server is not None:
+            server.stop(grace=None)
+        if service is not None and service.metrics_server is not None:
+            service.metrics_server.shutdown()
+        manager.unload_model(sc.model)
+        slo_mod.ENGINE.clear()

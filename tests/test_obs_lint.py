@@ -505,11 +505,11 @@ def test_recorder_event_kinds_bounded():
     from aios_tpu.faults import inject as faults_inject
     from aios_tpu.obs import flightrec
     from aios_tpu.runtime import service as runtime_service
-    from aios_tpu.serving import failover, pool
+    from aios_tpu.serving import autoscale, failover, pool
 
     kinds = _call_site_kinds(
         batching, engine_mod, pool, runtime_service, flightrec,
-        failover, faults_inject,
+        failover, faults_inject, autoscale,
     )
     assert kinds, "no recorder event call sites found"
     unknown = kinds - set(flightrec.EVENT_KINDS)
@@ -601,6 +601,73 @@ def test_faults_family_complete_and_typed():
     mi = module_info_for(inject)
     assert "POINTS" in names_used_in(mi.functions["_parse"].node)
     assert set(faults.MODES) == {"nth", "prob", "after"}
+
+
+AUTOSCALE_EXPECTED = {
+    "aios_tpu_autoscale_actions_total": "counter",
+}
+
+
+def test_autoscale_family_complete_and_typed():
+    """The SLO-autoscaler instrument the ISSUE 15 catalog promises, with
+    labels exactly (model, action, cause) — any NEW aios_tpu_autoscale_*
+    metric must be added here (and to docs/OBSERVABILITY.md) so the
+    family stays reviewed."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_autoscale_")
+    }
+    assert family == AUTOSCALE_EXPECTED
+    for m in _catalog():
+        if m.name.startswith("aios_tpu_autoscale_"):
+            assert tuple(m.labelnames) == ("model", "action", "cause")
+
+
+def test_autoscale_enums_closed_and_iterated_at_registration():
+    """``action`` and ``cause`` label values come from the closed
+    autoscale.ACTIONS / CAUSES tuples and nowhere else: the controller
+    pre-registers every (action, cause) child by iterating both enums
+    (the SLO-objectives pattern), and every ``_record(action, cause)``
+    call site's literals are members."""
+    from aios_tpu.analysis.core import (
+        call_string_heads, module_info_for, names_used_in,
+    )
+    from aios_tpu.serving import autoscale
+
+    assert autoscale.ACTIONS == (
+        "scale_up", "scale_down", "degrade", "restore",
+    )
+    assert autoscale.CAUSES == (
+        "burn", "ceiling", "recovery", "kill_switch",
+    )
+    assert autoscale.LADDER == (
+        "spec_off", "jump_off", "shed_best_effort",
+    )
+    mi = module_info_for(autoscale)
+    init = mi.functions["AutoscaleController.__init__"]
+    used = names_used_in(init.node)
+    assert "ACTIONS" in used and "CAUSES" in used, (
+        "autoscale metric children must be pre-registered by iterating "
+        "the closed enums"
+    )
+    # every action literal handed to _record is an ACTIONS member (the
+    # cause rides the second positional arg; heads() yields the first)
+    heads = {lit for lit, _ in call_string_heads(mi.tree, "_record")}
+    assert heads, "no _record call sites found"
+    assert heads <= set(autoscale.ACTIONS)
+    import ast as ast_mod
+
+    from aios_tpu.analysis.core import iter_calls
+
+    causes = set()
+    for call in iter_calls(mi.tree):
+        fn = call.func
+        name = getattr(fn, "attr", getattr(fn, "id", ""))
+        if name == "_record" and len(call.args) >= 2 and isinstance(
+            call.args[1], ast_mod.Constant
+        ):
+            causes.add(call.args[1].value)
+    assert causes and causes <= set(autoscale.CAUSES)
 
 
 def test_failover_outcomes_closed_enum():
