@@ -81,6 +81,10 @@ LOCKS: Tuple[LockDecl, ...] = (
     # added-engine list) — engine builds and pool mutations run outside
     LockDecl("autoscale", "aios_tpu.serving.autoscale",
              "AutoscaleController", "_lock"),
+    # fleet: pure bookkeeping (member table, transition journal, peer
+    # set) — announces/scrapes (urllib) and metric/recorder emission
+    # for state edges always run outside it
+    LockDecl("fleet", "aios_tpu.obs.fleet", "FleetRegistry", "_lock"),
 )
 
 
@@ -126,6 +130,9 @@ CONTEXT_FNS: Dict[Tuple[str, str], Tuple[str, ...]] = {
     # ring accessor contract: only FlightRecorder.finish calls it, under
     # the recorder lock (the lazy setdefault would race otherwise)
     ("aios_tpu.obs.flightrec", "FlightRecorder._ring"): ("recorder",),
+    # journal appends happen inside the state-transition critical
+    # sections of _observe/tick (see _journal_append docstring)
+    ("aios_tpu.obs.fleet", "FleetRegistry._journal_append"): ("fleet",),
 }
 
 # hook attributes whose call target is registered dynamically:

@@ -29,11 +29,12 @@ seeded faults armed).
 
 from .scenario import SLOTargets, StormScenario, TenantSpec, load_scenario
 from .trace import Call, build_trace, trace_fingerprint
-from .driver import Outcome, StormDriver
+from .driver import FleetStormDriver, Outcome, StormDriver, target_of
 from .report import build_report
 
 __all__ = [
     "Call",
+    "FleetStormDriver",
     "Outcome",
     "SLOTargets",
     "StormDriver",
@@ -42,5 +43,6 @@ __all__ = [
     "build_report",
     "build_trace",
     "load_scenario",
+    "target_of",
     "trace_fingerprint",
 ]

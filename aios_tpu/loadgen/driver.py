@@ -23,8 +23,9 @@ import re
 import threading
 import time
 import urllib.request
+import zlib
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import grpc
 
@@ -193,3 +194,81 @@ class StormDriver:
                 return json.loads(r.read().decode())
         except Exception as exc:  # noqa: BLE001 - surface absence is data
             return {"error": repr(exc)[:120]}
+
+
+def target_of(tenant: str, n_targets: int) -> int:
+    """Deterministic tenant -> target routing for multi-endpoint storms:
+    a pure function of the tenant NAME, so (a) two seeded runs route
+    identically (the per-target fingerprint is comparable with ``==``)
+    and (b) cache-coupled families (shared preambles, fork children —
+    always same-tenant) land on one target, keeping the radix-cache
+    determinism argument intact across the fan-out."""
+    if n_targets <= 1:
+        return 0
+    return zlib.crc32(tenant.encode("utf-8")) % n_targets
+
+
+class FleetStormDriver:
+    """The multi-target storm driver: one :class:`StormDriver` per
+    endpoint, the trace spread over them by :func:`target_of`. The
+    verdict side (loadgen/report.py) aggregates one fingerprint per
+    target off the ``target`` extra stamped on every outcome."""
+
+    def __init__(self, addresses: Sequence[str], model: str,
+                 metrics_ports: Optional[Sequence[Optional[int]]] = None,
+                 time_scale: float = 1.0) -> None:
+        if not addresses:
+            raise ValueError("FleetStormDriver needs at least one address")
+        ports: Sequence[Optional[int]] = (
+            metrics_ports if metrics_ports is not None
+            else [None] * len(addresses)
+        )
+        if len(ports) != len(addresses):
+            raise ValueError("metrics_ports must match addresses")
+        self.drivers = [
+            StormDriver(addr, model, metrics_port=p, time_scale=time_scale)
+            for addr, p in zip(addresses, ports)
+        ]
+        self.time_scale = time_scale
+
+    def close(self) -> None:
+        for d in self.drivers:
+            d.close()
+
+    def warmup(self, n: int = 3, max_tokens: int = 8) -> None:
+        for d in self.drivers:
+            d.warmup(n=n, max_tokens=max_tokens)
+
+    def run(self, calls: List[Call],
+            join_timeout: float = 180.0) -> List[Outcome]:
+        """Same wall-clock replay contract as StormDriver.run, each call
+        fired at its tenant's target; outcomes carry
+        ``extras["target"]``."""
+        outcomes = [Outcome(call=c) for c in calls]
+        n = len(self.drivers)
+        threads = []
+        t0 = time.monotonic()
+        for c, out in zip(calls, outcomes):
+            delay = c.t * self.time_scale - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            target = target_of(c.tenant, n)
+            out.extras["target"] = target
+            th = threading.Thread(
+                target=self.drivers[target]._fire, args=(c, out),
+                daemon=True, name=f"storm-{c.task_id}",
+            )
+            th.start()
+            threads.append(th)
+        deadline = time.monotonic() + join_timeout
+        for th, out in zip(threads, outcomes):
+            th.join(timeout=max(deadline - time.monotonic(), 0.1))
+            if th.is_alive():
+                out.status, out.detail = "error", "stuck"
+        return outcomes
+
+    def slo_surface(self) -> Dict[str, dict]:
+        """Per-target /debug/slo readback, keyed by target index."""
+        return {
+            str(i): d.slo_surface() for i, d in enumerate(self.drivers)
+        }

@@ -157,6 +157,13 @@ def build_report(sc: StormScenario, calls: List[Call],
         "stuck": stuck,
         "pass": passed,
     }
+    per_target = _per_target(outcomes)
+    if per_target:
+        # multi-endpoint storms (FleetStormDriver): one fingerprint per
+        # target. Routing is a pure function of the tenant name, so
+        # submitted counts are deterministic; completion pins only for
+        # non-deadline tenants (the same exclusion as the tenant table)
+        verdict["per_target"] = per_target
     measured = {
         "classes": classes,
         "deadline_tenants": tenants_measured,
@@ -173,6 +180,33 @@ def build_report(sc: StormScenario, calls: List[Call],
         "slo_surface": slo_surface,
     }
     return {"verdict": verdict, "measured": measured, "pass": passed}
+
+
+def _per_target(outcomes: List[Outcome]) -> dict:
+    """Per-target deterministic fingerprint for multi-endpoint storms:
+    submitted counts per target (pure trace+routing function), plus
+    completed/shed/rejected restricted to non-deadline tenants (a
+    deadline verdict is load timing — the build_report exclusion).
+    Empty when no outcome carries a target (single-endpoint storms keep
+    their verdict shape unchanged)."""
+    rows: Dict[int, dict] = {}
+    for o in outcomes:
+        t = o.extras.get("target")
+        if t is None:
+            return {}
+        row = rows.setdefault(int(t), {
+            "submitted": 0, "completed": 0, "shed": 0, "rejected": 0,
+        })
+        row["submitted"] += 1
+        if o.call.deadline_ms > 0:
+            continue
+        if o.status == "ok":
+            row["completed"] += 1
+        elif o.status == "shed":
+            row["shed"] += 1
+        elif o.status == "rejected":
+            row["rejected"] += 1
+    return {str(k): rows[k] for k in sorted(rows)}
 
 
 def _cause_tally(outcomes: List[Outcome]) -> dict:

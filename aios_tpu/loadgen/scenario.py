@@ -105,6 +105,12 @@ class StormScenario:
     tenant_tokens_per_sec: float = 0.0  # 0 = quotas off
     tenant_burst_tokens: float = 0.0
     max_queue: int = 64
+    # multi-target storms (the fleet driver): explicit runtime endpoints
+    # to spread the trace over. Empty = single target supplied by the
+    # harness at run time; the VERDICT then aggregates one fingerprint
+    # per endpoint (tenant -> target routing is deterministic, so the
+    # per-target counts are part of the determinism contract).
+    endpoints: Tuple[str, ...] = ()
 
     def tenant(self, name: str) -> TenantSpec:
         for t in self.tenants:
@@ -149,6 +155,7 @@ def _build(data: dict, path: str) -> StormScenario:
         tenant_tokens_per_sec=float(sc.get("tenant_tokens_per_sec", 0.0)),
         tenant_burst_tokens=float(sc.get("tenant_burst_tokens", 0.0)),
         max_queue=int(sc.get("max_queue", 64)),
+        endpoints=tuple(str(e) for e in sc.get("endpoints", ())),
         tenants=tuple(tenants),
         slo=slo,
     )

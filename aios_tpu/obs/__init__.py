@@ -25,7 +25,13 @@ package is that substrate for the aiOS-TPU stack:
                           the ``aios_tpu_slo_*`` family and folded into
                           every /healthz;
   * ``obs.http``        — stdlib /metrics + /healthz + /debug/* endpoint
-                          each service's serve() can start.
+                          each service's serve() can start;
+  * ``obs.fleet``       — the fleet telemetry plane: membership
+                          heartbeats with suspect/dead failure
+                          detection, /metrics/fleet federation, and
+                          cross-process trace stitching (the placement/
+                          failover signal the multi-host data plane
+                          routes on).
 
 No third-party dependencies: prometheus_client is not in the image, so
 the registry is self-contained stdlib code.
@@ -51,6 +57,7 @@ from .tracing import (  # noqa: F401
 from .http import start_metrics_server, maybe_start_metrics_server  # noqa: F401
 from . import flightrec  # noqa: F401
 from . import slo  # noqa: F401 - registers the recorder's SLO listener
+from . import fleet  # noqa: F401 - fleet membership/federation plane
 from .flightrec import RECORDER, FlightRecorder, Timeline  # noqa: F401
 
 # Wire the previously-dormant span-exporter hook: finished spans fold

@@ -73,6 +73,11 @@ EVENT_KINDS = (
     # "autoscale": an SLO-burn controller action (scale up/down, degrade
     # ladder rung, restore) on the model lane (serving/autoscale.py)
     "autoscale",
+    # "fleet_member": a membership state-machine edge (new/up/suspect/
+    # dead) on the "fleet" pseudo-model lane (obs/fleet.py) — the same
+    # evidence as the transition journal, time-aligned with request
+    # timelines
+    "fleet_member",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these

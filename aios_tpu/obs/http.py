@@ -16,11 +16,21 @@ Routes:
     payload's status is not ``ok`` — a degraded service or an SLO
     breach takes the replica out of LB rotation, without the process
     kill a liveness probe would cause;
+  * ``/metrics/fleet`` — federation: the union of every live fleet
+    member's /metrics with a ``host`` label injected (404 until
+    obs/fleet.py is armed);
+  * ``/fleet/members`` — the fleet membership table + transition
+    journal (JSON; what fleetctl renders);
+  * ``/fleet/announce`` — POST: one member's heartbeat descriptor in,
+    ours + known peers back (the membership gossip hop);
   * ``/debug/requests``  — recent flight-recorder timelines (JSON;
-    ``?model=&limit=&events=0``);
+    ``?model=&limit=&events=0&trace=<id>``);
   * ``/debug/trace``     — the same timelines as Chrome trace-event /
     Perfetto JSON (``?model=&limit=``, or ``?snapshot=<id>`` to render a
     frozen anomaly snapshot);
+  * ``/debug/trace/fleet`` — one trace id stitched ACROSS the fleet:
+    matching timelines fetched from every live peer's recorder, merged
+    into per-host Chrome-trace lanes (``?trace=<id>``);
   * ``/debug/spans``     — the finished-span ring (``?name=&limit=``);
   * ``/debug/slo``       — per-model objective evaluation + per-tenant
     breakdown;
@@ -57,7 +67,7 @@ def _debug_response(
     because the obs package __init__ imports THIS module before them
     (they are package-level imports everywhere else — every process
     importing aios_tpu.obs has them loaded)."""
-    from . import devprof, flightrec, slo, tracing
+    from . import devprof, fleet, flightrec, slo, tracing
 
     def q(name: str, default: str = "") -> str:
         return query.get(name, [default])[0]
@@ -70,15 +80,32 @@ def _debug_response(
 
     status = 200
     if path == "/debug/requests":
+        trace = q("trace")
+        limit = qint("limit", 64)
         tls = flightrec.RECORDER.recent(
-            model=q("model"), limit=qint("limit", 64)
+            model=q("model"), limit=limit * 4 if trace else limit
         )
+        if trace:
+            # trace filter: the fleet stitcher (and humans chasing one
+            # request) want exactly the timelines sharing a traceparent
+            tls = [t for t in tls if t.trace_id == trace][-limit:]
         body = json.dumps({
             "requests": [
                 t.to_dict(events=q("events", "1") not in ("0", "false"))
                 for t in tls
             ],
         })
+    elif path == "/debug/trace/fleet":
+        if fleet.FLEET is None:
+            body = json.dumps({"error": "fleet telemetry not armed"})
+            status = 404
+        elif not q("trace"):
+            body = json.dumps({"error": "trace id required (?trace=<id>)"})
+            status = 400
+        else:
+            body = json.dumps(fleet.FLEET.stitch(
+                q("trace"), limit=qint("limit", 64)
+            ))
     elif path == "/debug/trace":
         snap_id = qint("snapshot", 0)
         if snap_id:
@@ -196,6 +223,30 @@ def start_metrics_server(
             if path == "/metrics":
                 body = reg.render().encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics/fleet":
+                from . import fleet
+
+                if fleet.FLEET is None:
+                    body = b'{"error":"fleet telemetry not armed"}'
+                    ctype = "application/json"
+                    status = 404
+                else:
+                    body = fleet.FLEET.federate().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/fleet/members":
+                from . import fleet
+
+                if fleet.FLEET is None:
+                    body = b'{"error":"fleet telemetry not armed"}'
+                    status = 404
+                else:
+                    body = json.dumps({
+                        "self": fleet.FLEET.identity,
+                        "members": fleet.FLEET.members(),
+                        "journal": fleet.FLEET.journal(),
+                        "summary": fleet.FLEET.health_summary(),
+                    }).encode("utf-8")
+                ctype = "application/json"
             elif path == "/livez":
                 # pure liveness: always 200 while the process answers.
                 # Point k8s livenessProbe HERE — /healthz 503s on SLO
@@ -206,13 +257,22 @@ def start_metrics_server(
                 body = b'{"status":"alive"}'
                 ctype = "application/json"
             elif path == "/healthz":
-                payload = {"status": "ok"}
+                # the ACTUAL bound port rides every probe: with
+                # AIOS_<SVC>_METRICS_PORT=0 the ephemeral port was
+                # otherwise only in serve()'s return value — fleet
+                # peers and tests discover it here
+                payload = {
+                    "status": "ok",
+                    "metrics_port": self.server.server_address[1],
+                }
                 if health_fn is not None:
                     try:
                         payload.update(health_fn())
                     except Exception as exc:  # noqa: BLE001
                         payload = {"status": "degraded",
-                                   "error": repr(exc)[:200]}
+                                   "error": repr(exc)[:200],
+                                   "metrics_port":
+                                       self.server.server_address[1]}
                 # degraded/SLO-breach is a PROBE FAILURE, not prose: load
                 # balancers and k8s probes act on the status code, so a
                 # body saying "degraded" under HTTP 200 kept sick
@@ -244,6 +304,34 @@ def start_metrics_server(
                 return
             self.send_response(status)
             self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            from . import fleet
+
+            parsed = urlparse(self.path)
+            if parsed.path != "/fleet/announce":
+                self.send_error(404)
+                return
+            if fleet.FLEET is None:
+                self.send_error(404, "fleet telemetry not armed")
+                return
+            try:
+                n = min(int(self.headers.get("Content-Length", 0)),
+                        4 << 20)
+                desc = json.loads(self.rfile.read(n).decode("utf-8"))
+                if not isinstance(desc, dict):
+                    raise ValueError("announce body must be an object")
+                body = json.dumps(fleet.FLEET.receive(desc)).encode("utf-8")
+                status = 200
+            except Exception as exc:  # noqa: BLE001 - a malformed
+                # announce must not take down the exposition endpoint
+                body = json.dumps({"error": repr(exc)[:200]}).encode("utf-8")
+                status = 400
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -283,9 +371,18 @@ def maybe_start_metrics_server(
             )
             return None, None
     try:
-        return start_metrics_server(
+        server, bound = start_metrics_server(
             port=metrics_port, host=host, health_fn=health_fn
         )
+        # the service name + ACTUAL port in one startup line: with
+        # AIOS_<SVC>_METRICS_PORT=0 this log (plus /healthz and the
+        # fleet announce) is how anything finds the endpoint
+        log.info("%s metrics endpoint bound on port %d", service_name,
+                 bound)
+        from . import fleet
+
+        fleet.maybe_start(service_name, bound, host=host)
+        return server, bound
     except (OSError, OverflowError) as exc:  # taken port / port > 65535
         # the endpoint is optional: a taken/invalid port must not crash a
         # serve() whose gRPC server is already up

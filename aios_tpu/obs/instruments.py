@@ -5,7 +5,8 @@ Naming convention (enforced by tests/test_obs_lint.py):
   * unit suffix from the approved set: ``_seconds``, ``_bytes``,
     ``_total`` (counts and count-valued gauges), ``_ratio``,
     ``_per_second``, ``_usd_total`` (spend counters end in ``_total``
-    with the currency inline).
+    with the currency inline), ``_info`` (the Prometheus info-gauge
+    convention: constant 1, identity in labels — unitless by design).
 
 Keeping every definition here (rather than scattered at point of use)
 makes drift visible in review, keeps duplicate-registration impossible,
@@ -429,6 +430,46 @@ DEVPROF_TENANT_SECONDS = Counter(
     "occupancy + measured prefill time; per-model detail in "
     "/debug/devprof)",
     ("tenant",),
+)
+
+# -- fleet telemetry plane (obs/fleet.py, docs/OBSERVABILITY.md) -----------
+# Every series is labeled (host, role) — host ids are one-per-process
+# (bounded by fleet size, never per-request), and the transitions
+# counter's ``state`` label is the CLOSED fleet.MEMBER_STATES enum
+# (up|suspect|dead); the registry pre-registers every (host, role,
+# state) child by iterating the tuple when a member is first seen (the
+# autoscale/SLO registration pattern).
+
+FLEET_MEMBER_UP = Gauge(
+    "aios_tpu_fleet_member_up_total",
+    "1 while the member's heartbeat is fresh, 0 once the failure "
+    "detector marks it suspect/dead (the fleet 'up' boolean, per host "
+    "and role)",
+    ("host", "role"),
+)
+FLEET_TRANSITIONS = Counter(
+    "aios_tpu_fleet_member_transitions_total",
+    "Membership state-machine edges by destination state (state in the "
+    "closed fleet.MEMBER_STATES enum: up|suspect|dead; every edge also "
+    "lands in the transition journal and on the fleet recorder lane)",
+    ("host", "role", "state"),
+)
+FLEET_SCRAPE_FAILURES = Counter(
+    "aios_tpu_fleet_scrape_failures_total",
+    "Federation/stitch fetches of a live member's endpoint that failed "
+    "(the host drops out of that /metrics/fleet response — absence plus "
+    "this counter is the signal)",
+    ("host", "role"),
+)
+
+# -- process identity (obs/fleet.py stamp, every metrics endpoint) ---------
+
+PROCESS_INFO = Gauge(
+    "aios_tpu_process_info",
+    "Process identity info-gauge (constant 1): host id, multihost rank, "
+    "service role, package version — joins federated scrapes and bench "
+    "captures to the process that produced them",
+    ("host", "rank", "role", "version"),
 )
 
 # -- fault injection (aios_tpu/faults/, docs/FAULTS.md) --------------------

@@ -35,11 +35,56 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional, Tuple
+from dataclasses import dataclass
+from typing import Mapping, Optional, Tuple
 
 log = logging.getLogger("aios.multihost")
 
 _initialized = False
+
+
+@dataclass(frozen=True)
+class EnvContract:
+    """Parsed multihost env contract. ``auto`` means
+    AIOS_TPU_MULTIHOST=auto|1 (pod self-describe); the explicit path
+    carries coordinator + num_processes + process_id."""
+
+    coordinator: str = ""
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    auto: bool = False
+
+
+def env_contract(
+    env: Optional[Mapping[str, str]] = None,
+) -> Optional[EnvContract]:
+    """Parse the AIOS_TPU_* multihost contract WITHOUT touching jax —
+    the fleet telemetry plane reads rank/coordinator from here, and the
+    fast CPU unit tests drive it with fake environments. Returns None
+    for single-host (neither AIOS_TPU_COORDINATOR nor
+    AIOS_TPU_MULTIHOST set); raises ValueError when the explicit
+    coordinator path is missing its companion vars."""
+    e = os.environ if env is None else env
+    coord = e.get("AIOS_TPU_COORDINATOR", "")
+    auto = e.get("AIOS_TPU_MULTIHOST", "").lower() in ("1", "auto")
+    if not coord and not auto:
+        return None
+    num = e.get("AIOS_TPU_NUM_PROCESSES")
+    pid = e.get("AIOS_TPU_PROCESS_ID")
+    if coord and not auto and not (num and pid is not None and pid != ""):
+        # fail with OUR contract in the message, not JAX's cluster-detect
+        # internals: the explicit coordinator path needs all three vars
+        raise ValueError(
+            "AIOS_TPU_COORDINATOR requires AIOS_TPU_NUM_PROCESSES and "
+            "AIOS_TPU_PROCESS_ID (or set AIOS_TPU_MULTIHOST=auto on a "
+            "self-describing Cloud TPU pod)"
+        )
+    return EnvContract(
+        coordinator=coord,
+        num_processes=int(num) if num else None,
+        process_id=int(pid) if pid else None,
+        auto=auto,
+    )
 
 
 def initialize(
@@ -80,25 +125,14 @@ def initialize_from_env() -> bool:
     """Join the process group iff AIOS_TPU_COORDINATOR (explicit contract)
     or AIOS_TPU_MULTIHOST=auto (pod auto-detect) is set — the service
     startup hook; a no-op in the common single-host deployment."""
-    coord = os.environ.get("AIOS_TPU_COORDINATOR", "")
-    auto = os.environ.get("AIOS_TPU_MULTIHOST", "").lower() in ("1", "auto")
-    if not coord and not auto:
+    contract = env_contract()
+    if contract is None:
         return False
-    num = os.environ.get("AIOS_TPU_NUM_PROCESSES")
-    pid = os.environ.get("AIOS_TPU_PROCESS_ID")
-    if coord and not auto and not (num and pid is not None and pid != ""):
-        # fail with OUR contract in the message, not JAX's cluster-detect
-        # internals: the explicit coordinator path needs all three vars
-        raise ValueError(
-            "AIOS_TPU_COORDINATOR requires AIOS_TPU_NUM_PROCESSES and "
-            "AIOS_TPU_PROCESS_ID (or set AIOS_TPU_MULTIHOST=auto on a "
-            "self-describing Cloud TPU pod)"
-        )
     return initialize(
-        coord or None,
-        int(num) if num else None,
-        int(pid) if pid else None,
-        auto=auto,
+        contract.coordinator or None,
+        contract.num_processes,
+        contract.process_id,
+        auto=contract.auto,
     )
 
 

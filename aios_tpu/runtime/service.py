@@ -24,7 +24,7 @@ from typing import Iterator, Optional
 import grpc
 
 from .. import rpc
-from ..obs import flightrec, instruments as obs, slo, tracing
+from ..obs import fleet, flightrec, instruments as obs, slo, tracing
 from ..obs.http import maybe_start_metrics_server
 from ..proto_gen import common_pb2, runtime_pb2
 from ..services import RUNTIME, AIRuntimeServicer, service_address
@@ -454,6 +454,15 @@ def serve(
     rpc.add_to_server(RUNTIME, service, server)
     port = server.add_insecure_port(address)
     server.start()
+    # pool stats ride every fleet heartbeat (obs/fleet.py): peers rank
+    # hosts by live occupancy/degrade level without scraping each model.
+    # Registered before the metrics server so the registry's very first
+    # announce already carries them.
+    fleet.add_stats_provider(lambda: {
+        m.name: m.pool.heartbeat_stats()
+        for m in service.manager.ready_models()
+        if m.pool is not None
+    })
     service.metrics_server, service.metrics_port = maybe_start_metrics_server(
         "runtime",
         metrics_port,

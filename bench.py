@@ -86,9 +86,22 @@ def _platform_stamp() -> dict:
         return {"platform": "unavailable", "device_kind": repr(e)[:120]}
 
 
+def _process_stamp() -> dict:
+    """The fleet process identity (host id, role, rank, version —
+    obs/fleet.py) on every line: a capture archived off a multi-host
+    sweep says WHICH process produced it, not just which backend."""
+    try:
+        from aios_tpu.obs import fleet
+
+        return {"process_info": fleet.process_identity("bench")}
+    except Exception as e:  # import half-broken mid-bisect: stamp that
+        return {"process_info": {"error": repr(e)[:120]}}
+
+
 def emit(obj):
     stamped = dict(_platform_stamp())
     stamped["schema_version"] = BENCH_SCHEMA_VERSION
+    stamped.update(_process_stamp())
     stamped.update(obj)  # an explicit platform/schema in obj wins
     print(json.dumps(stamped), flush=True)
 

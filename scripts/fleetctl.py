@@ -1,0 +1,210 @@
+#!/usr/bin/env python3
+"""Fleet status CLI — the operator surface over ``/fleet/members``
+(docs/RUNBOOK.md §9 "a host is sick").
+
+Usage:
+    scripts/fleetctl.py status      [--target HOST:PORT]
+    scripts/fleetctl.py top         [--target HOST:PORT]
+    scripts/fleetctl.py drain-check [--target HOST:PORT] --host HOSTID
+
+Target is any ONE member's metrics endpoint (``--target``, else
+``AIOS_TPU_FLEET_TARGET``, default 127.0.0.1:9100) — membership is
+symmetric, so any member renders the whole fleet.
+
+  * ``status``      — the membership table: host, role, state, heartbeat
+                      age, rank, version, pid, metrics endpoint; plus
+                      the recent transition journal. Exit 0 when every
+                      member is "up", 1 when any is suspect/dead (the
+                      scriptable health probe), 2 when the target is
+                      unreachable.
+  * ``top``         — per-host load: pool occupancy / waiting / degrade
+                      rung, devprof MFU and device-seconds, SLO worst
+                      burn — sorted worst-burn-first so the sick host is
+                      the top row. Exit codes as ``status``.
+  * ``drain-check`` — is ``--host`` safe to take down? Exit 0 when every
+                      one of its pools reports zero waiting and zero
+                      batch occupancy (idle), 1 when it still holds
+                      work, 2 when the host is unknown or the target is
+                      unreachable.
+
+Human-readable tables go to stderr; ONE machine-readable JSON verdict
+line goes to stdout (the benchdiff.py convention), so scripts can parse
+the verdict while operators read the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import List, Optional
+
+
+def log(*args) -> None:
+    print(*args, file=sys.stderr, flush=True)
+
+
+def default_target() -> str:
+    return os.environ.get("AIOS_TPU_FLEET_TARGET", "127.0.0.1:9100")
+
+
+def fetch_members(target: str, timeout: float = 5.0) -> dict:
+    url = f"http://{target}/fleet/members"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _table(rows: List[List[str]], header: List[str]) -> None:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows)
+        for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    log(fmt.format(*header))
+    for r in rows:
+        log(fmt.format(*(str(c) for c in r)))
+
+
+def _pool_load(member: dict) -> tuple:
+    """(waiting, occupancy, degrade) summed/maxed across the member's
+    pools — the load triple top and drain-check read."""
+    waiting, occupancy, degrade = 0, 0.0, 0
+    for name, stats in (member.get("pools") or {}).items():
+        if name == "_error" or not isinstance(stats, dict):
+            continue
+        waiting += int(stats.get("waiting", 0) or 0)
+        occupancy = max(occupancy,
+                        float(stats.get("batch_occupancy", 0.0) or 0.0))
+        degrade = max(degrade, int(stats.get("degrade_level", 0) or 0))
+    return waiting, occupancy, degrade
+
+
+def _mfu_secs(member: dict) -> tuple:
+    mfu: Optional[float] = None
+    secs = 0.0
+    for entry in (member.get("capacity") or {}).values():
+        if not isinstance(entry, dict):
+            continue
+        secs += float(entry.get("device_seconds", 0.0) or 0.0)
+        if entry.get("mfu") is not None:
+            mfu = max(mfu or 0.0, float(entry["mfu"]))
+    return mfu, secs
+
+
+def cmd_status(data: dict) -> int:
+    members = data.get("members", [])
+    rows = [
+        [m["host"], m["role"], m["state"], f"{m.get('age_secs', 0):.1f}s",
+         m.get("rank") or "-", m.get("version") or "-",
+         m.get("pid") or "-", m.get("metrics_addr") or "-",
+         "*" if m.get("self") else ""]
+        for m in members
+    ]
+    _table(rows, ["HOST", "ROLE", "STATE", "AGE", "RANK", "VERSION",
+                  "PID", "METRICS", "SELF"])
+    journal = data.get("journal", [])
+    if journal:
+        log("")
+        log("recent transitions:")
+        for e in journal[-8:]:
+            log(f"  {e['host']}/{e['role']}: "
+                f"{e.get('from') or 'new'} -> {e['to']}")
+    not_up = [m for m in members if m["state"] != "up"]
+    print(json.dumps({
+        "cmd": "status", "size": len(members),
+        "up": len(members) - len(not_up),
+        "not_up": [{"host": m["host"], "role": m["role"],
+                    "state": m["state"]} for m in not_up],
+        "pass": not not_up,
+    }, sort_keys=True))
+    return 0 if not not_up else 1
+
+
+def cmd_top(data: dict) -> int:
+    members = data.get("members", [])
+
+    def burn(m: dict) -> float:
+        b = (m.get("slo") or {}).get("worst_burn")
+        return float(b) if b is not None else -1.0
+
+    ordered = sorted(members, key=burn, reverse=True)
+    rows = []
+    for m in ordered:
+        waiting, occupancy, degrade = _pool_load(m)
+        mfu, secs = _mfu_secs(m)
+        b = (m.get("slo") or {}).get("worst_burn")
+        rows.append([
+            m["host"], m["state"],
+            f"{b:.2f}" if b is not None else "-",
+            f"{occupancy:.2f}", waiting, degrade,
+            f"{mfu:.3f}" if mfu is not None else "-",
+            f"{secs:.2f}",
+        ])
+    _table(rows, ["HOST", "STATE", "BURN", "OCCUP", "WAIT", "DEGRADE",
+                  "MFU", "DEV_SECS"])
+    not_up = [m for m in members if m["state"] != "up"]
+    print(json.dumps({
+        "cmd": "top",
+        "worst": ({"host": ordered[0]["host"], "burn": burn(ordered[0])}
+                  if ordered and burn(ordered[0]) >= 0 else None),
+        "pass": not not_up,
+    }, sort_keys=True))
+    return 0 if not not_up else 1
+
+
+def cmd_drain_check(data: dict, host: str) -> int:
+    targets = [m for m in data.get("members", []) if m["host"] == host]
+    if not targets:
+        log(f"drain-check: host {host!r} not in the membership table")
+        print(json.dumps({"cmd": "drain-check", "host": host,
+                          "error": "unknown host"}, sort_keys=True))
+        return 2
+    holding = []
+    for m in targets:
+        waiting, occupancy, _ = _pool_load(m)
+        if waiting > 0 or occupancy > 0:
+            holding.append({"role": m["role"], "waiting": waiting,
+                            "occupancy": occupancy})
+    verdict = {"cmd": "drain-check", "host": host,
+               "holding": holding, "pass": not holding}
+    if holding:
+        log(f"drain-check: {host} still holds work: {holding}")
+    else:
+        log(f"drain-check: {host} is idle — safe to drain")
+    print(json.dumps(verdict, sort_keys=True))
+    return 0 if not holding else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleetctl", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("cmd", choices=["status", "top", "drain-check"])
+    ap.add_argument("--target", default=default_target(),
+                    help="any member's metrics endpoint (host:port)")
+    ap.add_argument("--host", default="",
+                    help="host id to drain-check")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    try:
+        data = fetch_members(args.target, timeout=args.timeout)
+    except Exception as exc:  # noqa: BLE001 - unreachable target is the
+        # operator's first answer, render it as such
+        log(f"fleetctl: cannot reach {args.target}: {exc!r}")
+        print(json.dumps({"cmd": args.cmd, "target": args.target,
+                          "error": repr(exc)[:200]}, sort_keys=True))
+        return 2
+    if args.cmd == "status":
+        return cmd_status(data)
+    if args.cmd == "top":
+        return cmd_top(data)
+    if not args.host:
+        ap.error("drain-check requires --host")
+    return cmd_drain_check(data, args.host)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,15 @@
 #                                  verdict must be identical and PASS
 #                                  (aios_tpu/loadgen/, docs/TESTING.md)
 #                                  — every PR is gated under
-#                                  contention-realistic load.
+#                                  contention-realistic load;
+#   6. the fleet smoke           — scripts/fleet_smoke.py: two real
+#                                  runtime processes on ephemeral ports
+#                                  federate /metrics/fleet, stitch one
+#                                  trace across the gRPC boundary, and
+#                                  one is killed — the up -> suspect ->
+#                                  dead journal must be identical across
+#                                  two runs (aios_tpu/obs/fleet.py,
+#                                  docs/RUNBOOK.md §9).
 #
 # The devprof threshold here is looser than benchdiff's default: the
 # committed baseline was captured on a different run of a noisy shared-
@@ -45,23 +53,27 @@ threshold="${PREFLIGHT_DEVPROF_THRESHOLD:-0.75}"
 workdir="$(mktemp -d)"
 trap 'rm -rf "$workdir"' EXIT
 
-echo "[preflight 1/5] static analysis (scripts/analyze.sh)" >&2
+echo "[preflight 1/6] static analysis (scripts/analyze.sh)" >&2
 scripts/analyze.sh
 
-echo "[preflight 2/5] obs-lint subset (tests/test_obs_lint.py)" >&2
+echo "[preflight 2/6] obs-lint subset (tests/test_obs_lint.py)" >&2
 python -m pytest tests/test_obs_lint.py -q -p no:cacheprovider
 
-echo "[preflight 3/5] seeded chaos storm (bench.py --chaos)" >&2
+echo "[preflight 3/6] seeded chaos storm (bench.py --chaos)" >&2
 python bench.py --chaos > "$workdir/chaos.json"
 
-echo "[preflight 4/5] devprof sentinel (bench.py --devprof vs" \
+echo "[preflight 4/6] devprof sentinel (bench.py --devprof vs" \
      "BASELINE_DEVPROF.json, threshold +${threshold})" >&2
 python bench.py --devprof > "$workdir/devprof.json"
 python scripts/benchdiff.py BASELINE_DEVPROF.json \
     "$workdir/devprof.json" --threshold "$threshold"
 
-echo "[preflight 5/5] storm smoke (bench.py --storm --smoke," \
+echo "[preflight 5/6] storm smoke (bench.py --storm --smoke," \
      "seeded, run twice, deterministic verdict)" >&2
 python bench.py --storm --smoke > "$workdir/storm.json"
+
+echo "[preflight 6/6] fleet smoke (scripts/fleet_smoke.py: two" \
+     "processes federate + stitch, one dies, journals identical)" >&2
+python scripts/fleet_smoke.py > "$workdir/fleet.json"
 
 echo "[preflight] PASS" >&2

@@ -5,10 +5,13 @@ Rules (docs/OBSERVABILITY.md "naming"):
   * prefix ``aios_tpu_``, snake_case ``[a-z0-9_]`` only;
   * a unit suffix from the approved set — ``_seconds``, ``_bytes``,
     ``_total`` (primary trio), plus ``_ratio`` and ``_per_second`` for
-    unitless/rate gauges and ``_pages`` for KV page-pool occupancy
+    unitless/rate gauges, ``_pages`` for KV page-pool occupancy
     gauges (pages are the pool's native capacity unit — converting to
     bytes at scrape time would bake in dtype/geometry and break A/B
-    comparisons across cache dtypes);
+    comparisons across cache dtypes), and ``_info`` for identity
+    gauges (the Prometheus *_info convention: constant value 1, the
+    payload entirely in labels — a unit suffix would claim a
+    measurement the series deliberately does not make);
   * label names snake_case, bounded per-metric label count;
   * non-empty help text.
 """
@@ -21,7 +24,7 @@ from aios_tpu.obs.metrics import REGISTRY
 NAME_RE = re.compile(r"^aios_tpu_[a-z0-9_]+$")
 LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_per_second",
-                 "_pages")
+                 "_pages", "_info")
 
 
 def _catalog():
@@ -503,13 +506,13 @@ def test_recorder_event_kinds_bounded():
     flightrec.EVENT_KINDS enum."""
     from aios_tpu.engine import batching, engine as engine_mod
     from aios_tpu.faults import inject as faults_inject
-    from aios_tpu.obs import flightrec
+    from aios_tpu.obs import fleet, flightrec
     from aios_tpu.runtime import service as runtime_service
     from aios_tpu.serving import autoscale, failover, pool
 
     kinds = _call_site_kinds(
         batching, engine_mod, pool, runtime_service, flightrec,
-        failover, faults_inject, autoscale,
+        failover, faults_inject, autoscale, fleet,
     )
     assert kinds, "no recorder event call sites found"
     unknown = kinds - set(flightrec.EVENT_KINDS)
@@ -668,6 +671,70 @@ def test_autoscale_enums_closed_and_iterated_at_registration():
         ):
             causes.add(call.args[1].value)
     assert causes and causes <= set(autoscale.CAUSES)
+
+
+# -- the fleet telemetry family (obs/fleet.py, ISSUE 16) -------------------
+
+FLEET_EXPECTED = {
+    "aios_tpu_fleet_member_up_total": "gauge",
+    "aios_tpu_fleet_member_transitions_total": "counter",
+    "aios_tpu_fleet_scrape_failures_total": "counter",
+}
+
+
+def test_fleet_family_complete_and_typed():
+    """The fleet-plane instruments the ISSUE 16 catalog promises exist,
+    with the promised kinds — and any NEW aios_tpu_fleet_* metric must
+    be added here (and to docs/OBSERVABILITY.md) so the family stays
+    reviewed. member_up/scrape_failures carry exactly (host, role);
+    ONLY the transition counter adds the state dimension, and its
+    values come from the closed MEMBER_STATES enum."""
+    family = {
+        m.name: m.kind for m in _catalog()
+        if m.name.startswith("aios_tpu_fleet_")
+    }
+    assert family == FLEET_EXPECTED
+    for m in _catalog():
+        if m.name == "aios_tpu_fleet_member_transitions_total":
+            assert tuple(m.labelnames) == ("host", "role", "state")
+        elif m.name.startswith("aios_tpu_fleet_"):
+            assert tuple(m.labelnames) == ("host", "role"), (
+                f"{m.name}: fleet metrics carry exactly (host, role)"
+            )
+
+
+def test_fleet_member_states_closed_and_iterated_at_registration():
+    """The ``state`` label values come from the closed
+    fleet.MEMBER_STATES tuple and nowhere else: the registry
+    pre-registers every (host, role, state) child by iterating the enum
+    (the autoscale/SLO registration pattern), so a new lifecycle state
+    is a reviewed enum change, never a stray label value."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu.obs import fleet
+
+    assert fleet.MEMBER_STATES == ("up", "suspect", "dead")
+    mi = module_info_for(fleet)
+    fn = mi.functions["FleetRegistry._register_member_metrics"]
+    assert "MEMBER_STATES" in names_used_in(fn.node), (
+        "fleet transition children must be pre-registered by iterating "
+        "the MEMBER_STATES enum"
+    )
+    # the failure detector compares states by enum POSITION (a detector
+    # may only worsen a state) — it must read the same tuple
+    tick = mi.functions["FleetRegistry.tick"]
+    assert "MEMBER_STATES" in names_used_in(tick.node)
+
+
+def test_process_info_gauge_is_an_identity_series():
+    """aios_tpu_process_info is the catalog's one *_info gauge: identity
+    entirely in labels (host, rank, role, version), value pinned to 1 by
+    fleet.stamp_process_info — the join key for every federated series
+    and every bench.py JSON line."""
+    family = [m for m in _catalog() if m.name == "aios_tpu_process_info"]
+    assert len(family) == 1
+    m = family[0]
+    assert m.kind == "gauge"
+    assert tuple(m.labelnames) == ("host", "rank", "role", "version")
 
 
 def test_failover_outcomes_closed_enum():
