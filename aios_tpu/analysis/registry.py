@@ -85,6 +85,10 @@ LOCKS: Tuple[LockDecl, ...] = (
     # set) — announces/scrapes (urllib) and metric/recorder emission
     # for state edges always run outside it
     LockDecl("fleet", "aios_tpu.obs.fleet", "FleetRegistry", "_lock"),
+    # handoff: cancel/terminal flags and the live local-handle ref on a
+    # disaggregated stream — the transfer RPCs themselves (push, fetch,
+    # the handoff stream) always run outside it
+    LockDecl("handoff", "aios_tpu.fleet.disagg", "HandoffHandle", "_lock"),
 )
 
 

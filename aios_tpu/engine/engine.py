@@ -2875,6 +2875,81 @@ class TPUEngine:
                 rows += int(n_host * P * paged.HOST_OVERLAP_DISCOUNT)
         return rows
 
+    # -- fleet data plane (aios_tpu/fleet/) ---------------------------------
+
+    def export_prefix(self, token_ids: List[int], max_pages: int = 0):
+        """Device->host copy of the longest HBM-resident chain prefix of
+        the prompt — the transfer plane's push-on-prefill source.
+        Returns ``[(hash, entry)]`` in the HostPageStore entry layout
+        (the receiver ``put``s them straight into its host tier, and its
+        next ``_match_prefix`` restores them with a scatter instead of a
+        prefill). Empty on non-paged engines or when no full block is
+        resident.
+
+        Lock discipline mirrors ``_spill_pages``: the gather must
+        MATERIALIZE under the engine lock — the matched pages can be
+        evicted and rewritten by the next dispatch the moment it
+        releases — so the lock pays for the gather; the device->host
+        copies then run outside it on the caller's (transfer) thread."""
+        return self.export_hashes(self.prefix_hashes(token_ids), max_pages)
+
+    def export_hashes(self, hashes: List[bytes], max_pages: int = 0):
+        """Hash-keyed flavor of :meth:`export_prefix` — the transfer
+        servicer's ``Fetch`` path, where the puller sends chain hashes,
+        not token ids. Same return shape and lock discipline."""
+        if self.prefix_index is None or not hashes:
+            return []
+        with self._lock:
+            snap = self.prefix_index.snapshot()
+            chain = []
+            for h in hashes:
+                page = snap.get(h)
+                if page is None:
+                    break
+                chain.append((h, page))
+            if max_pages:
+                chain = chain[:max_pages]
+            if not chain:
+                return []
+            # aios: waive(lock-readback): host-side page-id list, no device sync
+            pages = np.asarray([p for _, p in chain], np.int32)
+            arrs = [self.state["k"][:, pages], self.state["v"][:, pages]]
+            if self.quant_cache:
+                arrs.append(self.state["k_s"][:, pages])
+                arrs.append(self.state["v_s"][:, pages])
+            # aios: waive(lock-readback): _spill_pages contract — the gather must materialize before the lock releases, or the exported pages could be rewritten by the next donated dispatch mid-copy
+            jax.block_until_ready(arrs)
+        keys = ("k", "v", "k_s", "v_s")
+        host = [np.asarray(a) for a in arrs]
+        return [
+            (
+                h,
+                {
+                    k: np.ascontiguousarray(host[j][:, i])
+                    for j, k in enumerate(keys[: len(host)])
+                },
+            )
+            for i, (h, _) in enumerate(chain)
+        ]
+
+    def prefix_digest(self, max_tails: int = 256) -> Dict[str, int]:
+        """Bounded digest of this engine's cached chains for the
+        gossiped fleet prefix index: truncated-hex chain hash ->
+        depth-in-blocks (0 = depth unknown). HBM entries first (they
+        are the cheap hits), then host-tier hashes into whatever of the
+        cap remains. 64-bit truncation keeps heartbeats small; a
+        collision can only misroute — the transfer then misses and the
+        request falls back to local prefill."""
+        if self.prefix_index is None:
+            return {}
+        out: Dict[str, int] = {}
+        for h, blocks in self.prefix_index.digest(max_tails):
+            out[h.hex()[:16]] = blocks
+        if self.host_store is not None and len(out) < max_tails:
+            for h in self.host_store.stored_hashes(max_tails - len(out)):
+                out.setdefault(h.hex()[:16], 0)
+        return out
+
     # -- public API ---------------------------------------------------------
 
     def bucket_for(self, length: int) -> int:

@@ -22,6 +22,7 @@ slots x context on a TPU chip (SURVEY.md section 7.2, hard part no. 1).
 from __future__ import annotations
 
 import logging
+import struct
 import zlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -342,6 +343,79 @@ def chain_hashes(
     return hashes
 
 
+# -- host-tier wire format (fleet KV transfer, aios_tpu/fleet/kvx.py) -------
+
+# One HostPageStore entry <-> self-describing bytes: magic, tensor count,
+# then per tensor key / dtype string / shape / raw buffer. The crc32 rides
+# the RPC envelope separately (fleet.proto PageEntry.crc32), computed by
+# HostPageStore._entry_crc over the ARRAYS — so the receiver re-derives it
+# from the unpacked entry and a flipped bit anywhere in transit (or in the
+# sender's host RAM) fails verification, never scatters into live KV.
+_WIRE_MAGIC = b"KVX1"
+
+
+def pack_entry(entry: Dict[str, np.ndarray]) -> bytes:
+    """Serialize one page-KV entry for the transfer plane (sorted keys,
+    so the byte stream — like the crc — is order-independent)."""
+    parts = [_WIRE_MAGIC, struct.pack("<B", len(entry))]
+    for key in sorted(entry):
+        a = np.ascontiguousarray(entry[key])
+        kb = key.encode("utf-8")
+        db = a.dtype.str.encode("ascii")
+        parts.append(struct.pack("<B", len(kb)))
+        parts.append(kb)
+        parts.append(struct.pack("<B", len(db)))
+        parts.append(db)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def unpack_entry(data: bytes) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`pack_entry`. Raises ``ValueError`` on any
+    malformed framing — the transfer plane counts that as a
+    ``decode_error`` and falls back to local prefill, exactly like a
+    failed host-tier restore. Arrays are COPIES (writable): store
+    entries must be mutable for the ``host_store.corrupt`` fault
+    point and immutable-by-convention everywhere else."""
+    if data[:4] != _WIRE_MAGIC:
+        raise ValueError("bad page-entry magic")
+    off = 4
+    try:
+        (n,) = struct.unpack_from("<B", data, off)
+        off += 1
+        entry: Dict[str, np.ndarray] = {}
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<B", data, off)
+            off += 1
+            key = data[off : off + klen].decode("utf-8")
+            off += klen
+            (dlen,) = struct.unpack_from("<B", data, off)
+            off += 1
+            dtype = np.dtype(data[off : off + dlen].decode("ascii"))
+            off += dlen
+            (ndim,) = struct.unpack_from("<B", data, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}I", data, off)
+            off += 4 * ndim
+            (nbytes,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            if off + nbytes > len(data):
+                raise ValueError("page-entry payload truncated")
+            a = np.frombuffer(
+                data[off : off + nbytes], dtype=dtype
+            ).reshape(shape).copy()
+            off += nbytes
+            entry[key] = a
+    except struct.error as exc:
+        raise ValueError(f"bad page-entry framing: {exc}") from exc
+    if off != len(data):
+        raise ValueError("trailing bytes after page-entry payload")
+    return entry
+
+
 class HostPageStore:
     """Host-RAM spill tier behind the prefix cache (hash -> page KV bytes).
 
@@ -508,6 +582,59 @@ class HostPageStore:
                 n += 1
         return n
 
+    def export_chain(
+        self, hashes: Sequence[bytes], budget_bytes: int = 0
+    ) -> List[Tuple[bytes, int, Dict[str, np.ndarray]]]:
+        """Longest stored prefix of ``hashes`` as wire-ready
+        ``(hash, crc32, entry)`` triples for the fleet transfer plane —
+        no LRU refresh and no hit/miss movement (exporting to a peer is
+        not a local restore probe). ``budget_bytes`` > 0 truncates the
+        chain once the cumulative entry size would exceed it.
+
+        The sender-side half of the verified-at-both-ends contract:
+        every entry's stored crc32 is recomputed here before it ships; a
+        mismatch (host-RAM rot since the spill) drops the entry, counts
+        a corruption, and truncates the chain — shipping a rotten page
+        would just move the receiver's crc failure one hop later."""
+        candidates: List[Tuple[bytes, Dict[str, np.ndarray], int]] = []
+        total = 0
+        with self._lock:
+            for h in hashes:
+                e = self._entries.get(h)
+                if e is None:
+                    break
+                total += self._entry_bytes(e)
+                if budget_bytes and total > budget_bytes and candidates:
+                    break
+                candidates.append((h, e, self._crcs.get(h)))
+        out: List[Tuple[bytes, int, Dict[str, np.ndarray]]] = []
+        bad: Optional[Tuple[bytes, Dict[str, np.ndarray]]] = None
+        for h, e, crc in candidates:
+            if crc != self._entry_crc(e):
+                bad = (h, e)
+                break
+            out.append((h, crc, e))
+        if bad is not None:
+            with self._lock:
+                if self._entries.get(bad[0]) is bad[1]:
+                    self._entries.pop(bad[0], None)
+                    self._crcs.pop(bad[0], None)
+                    self.bytes_resident -= self._entry_bytes(bad[1])
+                    self.corruptions += 1
+            log.error(
+                "host-tier page failed crc32 at export; dropped "
+                "(chain truncated at %d of %d)", len(out), len(hashes),
+            )
+        return out
+
+    def stored_hashes(self, limit: int) -> List[bytes]:
+        """Up to ``limit`` most-recently-used entry hashes — the host
+        tier's contribution to the gossiped prefix digest. Read-only
+        (no LRU refresh, no counters)."""
+        with self._lock:
+            keys = list(self._entries.keys())
+        return keys[-limit:] if limit else []
+
     def discard(self, hashes: Sequence[bytes], *, restored: bool = False
                 ) -> None:
         """Drop entries (restore promotion, or invalidation). With
@@ -631,6 +758,16 @@ class PrefixIndex(_PrefixIndexBase):
         (tests/diagnostics; both index implementations provide it)."""
         with self._lock:
             return dict(self._index)
+
+    def digest(self, limit: int) -> List[Tuple[bytes, int]]:
+        """Up to ``limit`` hottest ``(chain hash, depth-in-blocks)``
+        pairs for the gossiped fleet prefix digest. The flat map does
+        not track chain depth, so it advertises 0 (membership is what
+        remote overlap scoring consumes; depth is advisory). Read-only —
+        no LRU refresh, no counters."""
+        with self._lock:
+            keys = list(self._index.keys())
+        return [(h, 0) for h in keys[-limit:]] if limit else []
 
     def match(self, hashes: Sequence[bytes]) -> List[int]:
         """Longest indexed prefix of ``hashes``; returns its pages (LRU
@@ -1033,3 +1170,27 @@ class RadixPrefixIndex(_PrefixIndexBase):
                 out.update(n.entries)
                 stack.extend(n.children.values())
             return out
+
+    def digest(self, limit: int) -> List[Tuple[bytes, int]]:
+        """Up to ``limit`` ``(chain hash, depth-in-blocks)`` pairs for
+        the gossiped fleet prefix digest — breadth-first, so when the
+        cap bites, SHALLOW blocks survive: a remote prompt shorter than
+        a cached chain still finds its prefix hash in the digest, while
+        an over-deep match merely degrades to the advertised depth.
+        Read-only (same contract as ``peek``)."""
+        if not limit:
+            return []
+        out: List[Tuple[bytes, int]] = []
+        with self._lock:
+            queue: List[Tuple[_RadixNode, int]] = [(self._root, 0)]
+            while queue and len(out) < limit:
+                node, depth = queue.pop(0)
+                d = depth
+                for h, _ in node.entries:
+                    d += 1
+                    out.append((h, d))
+                    if len(out) >= limit:
+                        break
+                for child in node.children.values():
+                    queue.append((child, d))
+        return out

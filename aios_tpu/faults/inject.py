@@ -76,6 +76,11 @@ POINTS = (
     "rpc.unavailable",
     "allocator.pressure",
     "admission.clock_skew",
+    # decode-host loss mid-handoff (aios_tpu/fleet/disagg.py): the
+    # servicer aborts the stream — or, with exit=1, kills the whole
+    # process (the disagg smoke's real host kill) — and the prefill
+    # host re-hands the stream to a survivor
+    "fleet.host_kill",
 )
 
 MODES = ("nth", "prob", "after")
@@ -111,6 +116,11 @@ class FaultAction:
     delay_s: float = 0.0
     skew_s: float = 0.0
     retry_after_ms: int = 1000
+    # fleet.host_kill only: True = the call site should take the whole
+    # PROCESS down (os._exit), not just abort the stream — the disagg
+    # smoke's real host kill. Default False so in-process tests drive
+    # the same recovery path without dying.
+    exit: bool = False
 
 
 @dataclass
@@ -161,6 +171,7 @@ class FaultPlan:
                 delay_s=spec.params.get("delay_ms", 0.0) / 1e3,
                 skew_s=spec.params.get("skew_ms", 0.0) / 1e3,
                 retry_after_ms=int(spec.params.get("retry_after_ms", 1000)),
+                exit=bool(spec.params.get("exit", 0.0)),
             )
             self._journal.append(
                 {"point": name, "mode": spec.mode, "hit": hit,

@@ -187,6 +187,46 @@ def _self_pools() -> Dict[str, dict]:
     return pools
 
 
+# gossiped prefix digest providers (aios_tpu/fleet/gprefix.py): callables
+# returning {model: {"page": page_size, "tails": {hex16: blocks}}},
+# consumed at each heartbeat build — same registration pattern as the
+# pool-stats providers.
+_digest_providers: List[Callable[[], Dict[str, dict]]] = []
+
+# this process's KvTransfer endpoint (host:port), piggybacked on the
+# heartbeat so peers know where to Fetch/Push/Handoff; "" = no data plane
+_transfer_addr = ""
+
+
+def add_digest_provider(fn: Callable[[], Dict[str, dict]]) -> None:
+    """Register a per-model prefix-digest source for heartbeat payloads
+    (the fleet data plane's gossiped prefix index)."""
+    _digest_providers.append(fn)
+
+
+def clear_digest_providers() -> None:
+    """Test isolation."""
+    _digest_providers.clear()
+
+
+def set_transfer_addr(addr: str) -> None:
+    """Publish this process's KvTransfer gRPC endpoint on the heartbeat
+    (the runtime service calls this with its ACTUAL bound port)."""
+    global _transfer_addr
+    _transfer_addr = addr
+
+
+def _self_gprefix() -> Dict[str, dict]:
+    digest: Dict[str, dict] = {}
+    for fn in list(_digest_providers):
+        try:
+            digest.update(fn())
+        except Exception as exc:  # noqa: BLE001 - a sick engine must not
+            # stop the heartbeat; the failure is the payload
+            digest.setdefault("_error", {})["provider"] = repr(exc)[:120]
+    return digest
+
+
 def _self_slo() -> dict:
     """Compact SLO summary for the heartbeat: worst burn across models
     and objectives (None while no window is evaluable) plus per-model
@@ -391,7 +431,7 @@ class FleetRegistry:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._register_member_metrics(identity["host"], identity["role"])
-        self._apply_edges(self._observe(self.self_descriptor()))
+        self.self_descriptor()  # seeds the member table with self
         for addr in self.cfg.seed_peers():
             self._add_peer(addr)
 
@@ -415,20 +455,30 @@ class FleetRegistry:
 
     def self_descriptor(self) -> dict:
         """The heartbeat payload: identity + bound metrics endpoint +
-        pool stats + devprof capacity + SLO burn. Built OUTSIDE the
-        fleet lock (providers may take pool/slo locks)."""
+        pool stats + prefix digest + devprof capacity + SLO burn. Built
+        OUTSIDE the fleet lock (providers may take pool/slo/engine
+        locks)."""
         with self._lock:
             self._seq += 1
             seq = self._seq
-        return {
+        desc = {
             **self.identity,
             "metrics_addr": self.metrics_addr,
+            "kvx_addr": _transfer_addr,
             "pid": os.getpid(),
             "seq": seq,
             "pools": _self_pools(),
+            "gprefix": _self_gprefix(),
             "capacity": _self_capacity(),
             "slo": _self_slo(),
         }
+        # Every freshly built descriptor also refreshes OUR stored
+        # member row. Before this, self's desc was folded in only at
+        # construction, so /fleet/members reported the degrade-ladder
+        # rung (and every other live pool stat) as of boot — a
+        # controller mid-walk between ticks was invisible to fleetctl.
+        self._apply_edges(self._observe(desc))
+        return desc
 
     # -- membership state machine --------------------------------------------
 
@@ -550,8 +600,9 @@ class FleetRegistry:
                                     self.identity["role"]),
                     **{
                         k: m.get("desc", {}).get(k)
-                        for k in ("rank", "version", "metrics_addr", "pid",
-                                  "seq", "pools", "capacity", "slo")
+                        for k in ("rank", "version", "metrics_addr",
+                                  "kvx_addr", "pid", "seq", "pools",
+                                  "gprefix", "capacity", "slo")
                     },
                 }
                 for key, m in sorted(self._members.items())

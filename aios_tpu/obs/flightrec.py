@@ -78,6 +78,10 @@ EVENT_KINDS = (
     # evidence as the transition journal, time-aligned with request
     # timelines
     "fleet_member",
+    # "handoff": a disaggregated prefill->decode transfer of an
+    # in-flight stream to a peer host (aios_tpu/fleet/disagg.py) — on
+    # the request timeline when it rides one, else the model lane
+    "handoff",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these

@@ -461,6 +461,35 @@ FLEET_SCRAPE_FAILURES = Counter(
     "this counter is the signal)",
     ("host", "role"),
 )
+FLEET_KVX_PAGES = Counter(
+    "aios_tpu_fleet_kvx_pages_total",
+    "HostPageStore entries shipped over the fleet transfer plane, by "
+    "direction (closed kvx.KVX_DIRECTIONS enum: push = prefill host "
+    "streaming pages out, pull = decode host fetching on miss)",
+    ("model", "direction"),
+)
+FLEET_KVX_BYTES = Counter(
+    "aios_tpu_fleet_kvx_bytes_total",
+    "Payload bytes shipped over the fleet transfer plane, by direction "
+    "(same closed direction enum as the pages counter; packed wire "
+    "bytes, crc envelopes excluded)",
+    ("model", "direction"),
+)
+FLEET_KVX_FAILURES = Counter(
+    "aios_tpu_fleet_kvx_failures_total",
+    "Transfers that failed and fell back to local prefill, by cause "
+    "(closed kvx.KVX_FAIL_CAUSES enum — crc_mismatch is the receiving "
+    "end of the verified-at-both-ends contract rejecting a payload)",
+    ("model", "cause"),
+)
+FLEET_ROUTE = Counter(
+    "aios_tpu_fleet_route_total",
+    "Fleet-level routing decisions by reason (closed "
+    "router.FLEET_ROUTE_REASONS enum: the sticky -> overlap -> "
+    "least-loaded ladder extended fleet-wide, plus the disagg handoff "
+    "outcomes)",
+    ("model", "reason"),
+)
 
 # -- process identity (obs/fleet.py stamp, every metrics endpoint) ---------
 

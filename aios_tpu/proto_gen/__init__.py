@@ -6,6 +6,7 @@ from . import agent_pb2
 from . import tools_pb2
 from . import api_gateway_pb2
 from . import memory_pb2
+from . import fleet_pb2
 
 __all__ = [
     "common_pb2",
@@ -15,4 +16,5 @@ __all__ = [
     "tools_pb2",
     "api_gateway_pb2",
     "memory_pb2",
+    "fleet_pb2",
 ]

@@ -24,10 +24,12 @@ from typing import Iterator, Optional
 import grpc
 
 from .. import rpc
+from ..fleet import disagg as fleet_disagg
+from ..fleet import gprefix as fleet_gprefix
 from ..obs import fleet, flightrec, instruments as obs, slo, tracing
 from ..obs.http import maybe_start_metrics_server
 from ..proto_gen import common_pb2, runtime_pb2
-from ..services import RUNTIME, AIRuntimeServicer, service_address
+from ..services import KVTRANSFER, RUNTIME, AIRuntimeServicer, service_address
 from ..engine.batching import Request
 from ..engine.tokenizer import render_chat
 from ..serving import AdmissionError, tenant_of
@@ -342,7 +344,13 @@ class RuntimeService(AIRuntimeServicer):
                 deadline_s = tr
         try:
             try:
-                handle = m.submit(req, tenant=tenant, deadline_s=deadline_s)
+                # fleet data plane rung (fleet/disagg.py): exactly
+                # m.submit when the plane is disarmed; on a prefill-role
+                # host the returned handle hands the stream to a decode
+                # host after the first token
+                handle = fleet_disagg.route_submit(
+                    m, req, tenant=tenant, deadline_s=deadline_s
+                )
             except AdmissionError as e:
                 # load shed: RESOURCE_EXHAUSTED + a retry-after-ms
                 # trailing-metadata hint instead of an unbounded queue;
@@ -452,6 +460,13 @@ def serve(
     server = rpc.create_server()
     service = RuntimeService(manager)
     rpc.add_to_server(RUNTIME, service, server)
+    # the fleet transfer plane (aios.fleet.KvTransfer) rides the SAME
+    # server — registered unconditionally (answering Fetch/Push/Handoff
+    # on a solo host is harmless) so arming the fleet later needs no
+    # restart
+    rpc.add_to_server(
+        KVTRANSFER, fleet_disagg.DisaggService(service.manager), server
+    )
     port = server.add_insecure_port(address)
     server.start()
     # pool stats ride every fleet heartbeat (obs/fleet.py): peers rank
@@ -463,6 +478,16 @@ def serve(
         for m in service.manager.ready_models()
         if m.pool is not None
     })
+    # fleet data plane: publish this process's transfer endpoint + prefix
+    # digest on the heartbeat, and arm the disagg routing rung
+    host = address.rsplit(":", 1)[0].strip("[]")
+    reach = "127.0.0.1" if host in ("", "0.0.0.0", "::", "localhost") else host
+    fleet.set_transfer_addr(f"{reach}:{port}")
+    fleet.add_digest_provider(fleet_gprefix.provider(service.manager))
+    # the routing rung arms only on a configured fleet (or an explicit
+    # role): a solo host keeps the exact pre-fleet submit path
+    if fleet.FleetConfig().active() or os.environ.get("AIOS_TPU_FLEET_ROLE"):
+        fleet_disagg.arm(service.manager)
     service.metrics_server, service.metrics_port = maybe_start_metrics_server(
         "runtime",
         metrics_port,

@@ -57,6 +57,34 @@ MAX_BACKOFF_S = 5.0
 FAILOVER_OUTCOMES = ("resumed", "exhausted")
 
 
+def build_resume_request(pool, req: Request, emitted: List[int],
+                         failover=None) -> Request:
+    """The resume-from-emitted contract, shared by in-pool failover and
+    the fleet handoff plane (fleet/disagg.py): rebuild ``req`` as
+    ``prompt + already-emitted tokens`` with the remaining token budget.
+
+    Resumes from the ADMISSION-TRUNCATED prompt, not the raw one: the
+    engine kept only the last max_context-1 prompt ids, and appending
+    emitted tokens to the RAW prompt would shift the truncation window
+    by ``len(emitted)`` — a different conditioning context than the
+    fault-free run's KV. From the truncated base, base + emitted <=
+    max_context-1 always holds (a stream at the cap retires instead of
+    aborting), so the resubmit is never re-truncated and greedy identity
+    is preserved."""
+    base, _ = pool._route_ids(req)
+    return Request(
+        prompt_ids=list(base) + list(emitted),
+        max_tokens=max(req.max_tokens - len(emitted), 1),
+        temperature=req.temperature,
+        top_p=req.top_p,
+        stop_ids=req.stop_ids,
+        request_id=req.request_id,
+        priority=req.priority,
+        rec=req.rec,  # ONE timeline spans every attempt
+        failover=failover,
+    )
+
+
 class FailoverHandle:
     """Caller-side view of a failover-protected request: iterates like
     :class:`~aios_tpu.engine.batching.RequestHandle`, transparently
@@ -200,26 +228,8 @@ class FailoverHandle:
             MAX_BACKOFF_S,
         ) * (0.5 + random.random())
         time.sleep(delay_s)
-        remaining = max(self._req.max_tokens - len(self._emitted), 1)
-        # resume from the ADMISSION-TRUNCATED prompt, not the raw one:
-        # the engine kept only the last max_context-1 prompt ids, and
-        # appending emitted tokens to the RAW prompt would shift the
-        # truncation window by len(emitted) — a different conditioning
-        # context than the fault-free run's KV. From the truncated base,
-        # base + emitted <= max_context-1 always holds (a stream at the
-        # cap retires instead of aborting), so the resubmit is never
-        # re-truncated and greedy identity is preserved.
-        base, _ = self._pool._route_ids(self._req)
-        resumed = Request(
-            prompt_ids=list(base) + self._emitted,
-            max_tokens=remaining,
-            temperature=self._req.temperature,
-            top_p=self._req.top_p,
-            stop_ids=self._req.stop_ids,
-            request_id=self._req.request_id,
-            priority=self._req.priority,
-            rec=self._req.rec,  # ONE timeline spans every attempt
-            failover=self,
+        resumed = build_resume_request(
+            self._pool, self._req, self._emitted, failover=self
         )
         try:
             handle = self._pool.submit_failover(

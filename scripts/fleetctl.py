@@ -3,8 +3,8 @@
 (docs/RUNBOOK.md §9 "a host is sick").
 
 Usage:
-    scripts/fleetctl.py status      [--target HOST:PORT]
-    scripts/fleetctl.py top         [--target HOST:PORT]
+    scripts/fleetctl.py status      [--target HOST:PORT] [--json]
+    scripts/fleetctl.py top         [--target HOST:PORT] [--json]
     scripts/fleetctl.py drain-check [--target HOST:PORT] --host HOSTID
 
 Target is any ONE member's metrics endpoint (``--target``, else
@@ -29,7 +29,11 @@ symmetric, so any member renders the whole fleet.
 
 Human-readable tables go to stderr; ONE machine-readable JSON verdict
 line goes to stdout (the benchdiff.py convention), so scripts can parse
-the verdict while operators read the table.
+the verdict while operators read the table. ``--json`` (status/top)
+replaces the terse verdict with the FULL row set on stdout — the same
+fields the table renders, one JSON document — for dashboards and
+fleet-aware tooling that want data, not a verdict. Exit codes are
+identical either way.
 """
 
 from __future__ import annotations
@@ -93,8 +97,23 @@ def _mfu_secs(member: dict) -> tuple:
     return mfu, secs
 
 
-def cmd_status(data: dict) -> int:
+def cmd_status(data: dict, as_json: bool = False) -> int:
     members = data.get("members", [])
+    not_up = [m for m in members if m["state"] != "up"]
+    if as_json:
+        print(json.dumps({
+            "cmd": "status", "size": len(members),
+            "up": len(members) - len(not_up), "pass": not not_up,
+            "members": [
+                {k: m.get(k) for k in (
+                    "host", "role", "state", "age_secs", "rank",
+                    "version", "pid", "metrics_addr", "kvx_addr", "self",
+                )}
+                for m in members
+            ],
+            "journal": data.get("journal", [])[-32:],
+        }, sort_keys=True))
+        return 0 if not not_up else 1
     rows = [
         [m["host"], m["role"], m["state"], f"{m.get('age_secs', 0):.1f}s",
          m.get("rank") or "-", m.get("version") or "-",
@@ -111,7 +130,6 @@ def cmd_status(data: dict) -> int:
         for e in journal[-8:]:
             log(f"  {e['host']}/{e['role']}: "
                 f"{e.get('from') or 'new'} -> {e['to']}")
-    not_up = [m for m in members if m["state"] != "up"]
     print(json.dumps({
         "cmd": "status", "size": len(members),
         "up": len(members) - len(not_up),
@@ -122,7 +140,7 @@ def cmd_status(data: dict) -> int:
     return 0 if not not_up else 1
 
 
-def cmd_top(data: dict) -> int:
+def cmd_top(data: dict, as_json: bool = False) -> int:
     members = data.get("members", [])
 
     def burn(m: dict) -> float:
@@ -130,6 +148,23 @@ def cmd_top(data: dict) -> int:
         return float(b) if b is not None else -1.0
 
     ordered = sorted(members, key=burn, reverse=True)
+    not_up = [m for m in members if m["state"] != "up"]
+    if as_json:
+        out = []
+        for m in ordered:
+            waiting, occupancy, degrade = _pool_load(m)
+            mfu, secs = _mfu_secs(m)
+            b = (m.get("slo") or {}).get("worst_burn")
+            out.append({
+                "host": m["host"], "role": m["role"], "state": m["state"],
+                "worst_burn": b, "occupancy": occupancy,
+                "waiting": waiting, "degrade_level": degrade,
+                "mfu": mfu, "device_seconds": secs,
+            })
+        print(json.dumps({
+            "cmd": "top", "pass": not not_up, "members": out,
+        }, sort_keys=True))
+        return 0 if not not_up else 1
     rows = []
     for m in ordered:
         waiting, occupancy, degrade = _pool_load(m)
@@ -144,7 +179,6 @@ def cmd_top(data: dict) -> int:
         ])
     _table(rows, ["HOST", "STATE", "BURN", "OCCUP", "WAIT", "DEGRADE",
                   "MFU", "DEV_SECS"])
-    not_up = [m for m in members if m["state"] != "up"]
     print(json.dumps({
         "cmd": "top",
         "worst": ({"host": ordered[0]["host"], "burn": burn(ordered[0])}
@@ -188,6 +222,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--host", default="",
                     help="host id to drain-check")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="status/top: full row set as one JSON document "
+                         "on stdout instead of the table + verdict")
     args = ap.parse_args(argv)
     try:
         data = fetch_members(args.target, timeout=args.timeout)
@@ -198,9 +235,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "error": repr(exc)[:200]}, sort_keys=True))
         return 2
     if args.cmd == "status":
-        return cmd_status(data)
+        return cmd_status(data, as_json=args.as_json)
     if args.cmd == "top":
-        return cmd_top(data)
+        return cmd_top(data, as_json=args.as_json)
     if not args.host:
         ap.error("drain-check requires --host")
     return cmd_drain_check(data, args.host)

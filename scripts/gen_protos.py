@@ -30,6 +30,7 @@ PROTOS = [
     "tools.proto",
     "api_gateway.proto",
     "memory.proto",
+    "fleet.proto",
 ]
 
 

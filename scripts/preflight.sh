@@ -35,7 +35,18 @@
 #                                  one is killed — the up -> suspect ->
 #                                  dead journal must be identical across
 #                                  two runs (aios_tpu/obs/fleet.py,
-#                                  docs/RUNBOOK.md §9).
+#                                  docs/RUNBOOK.md §9);
+#   7. the disagg smoke          — scripts/disagg_smoke.py: one prefill
+#                                  + two decode processes serve one
+#                                  stream through the fleet data plane —
+#                                  KV chain pushed over the wire, the
+#                                  first decode host killed mid-stream
+#                                  (exit 17), the survivor finishes the
+#                                  stream token-identically to a solo
+#                                  run, and the survivor gossips the
+#                                  restored prefix digest; run twice,
+#                                  verdicts identical (aios_tpu/fleet/,
+#                                  docs/SERVING.md, docs/RUNBOOK.md §10).
 #
 # The devprof threshold here is looser than benchdiff's default: the
 # committed baseline was captured on a different run of a noisy shared-
@@ -53,27 +64,31 @@ threshold="${PREFLIGHT_DEVPROF_THRESHOLD:-0.75}"
 workdir="$(mktemp -d)"
 trap 'rm -rf "$workdir"' EXIT
 
-echo "[preflight 1/6] static analysis (scripts/analyze.sh)" >&2
+echo "[preflight 1/7] static analysis (scripts/analyze.sh)" >&2
 scripts/analyze.sh
 
-echo "[preflight 2/6] obs-lint subset (tests/test_obs_lint.py)" >&2
+echo "[preflight 2/7] obs-lint subset (tests/test_obs_lint.py)" >&2
 python -m pytest tests/test_obs_lint.py -q -p no:cacheprovider
 
-echo "[preflight 3/6] seeded chaos storm (bench.py --chaos)" >&2
+echo "[preflight 3/7] seeded chaos storm (bench.py --chaos)" >&2
 python bench.py --chaos > "$workdir/chaos.json"
 
-echo "[preflight 4/6] devprof sentinel (bench.py --devprof vs" \
+echo "[preflight 4/7] devprof sentinel (bench.py --devprof vs" \
      "BASELINE_DEVPROF.json, threshold +${threshold})" >&2
 python bench.py --devprof > "$workdir/devprof.json"
 python scripts/benchdiff.py BASELINE_DEVPROF.json \
     "$workdir/devprof.json" --threshold "$threshold"
 
-echo "[preflight 5/6] storm smoke (bench.py --storm --smoke," \
+echo "[preflight 5/7] storm smoke (bench.py --storm --smoke," \
      "seeded, run twice, deterministic verdict)" >&2
 python bench.py --storm --smoke > "$workdir/storm.json"
 
-echo "[preflight 6/6] fleet smoke (scripts/fleet_smoke.py: two" \
+echo "[preflight 6/7] fleet smoke (scripts/fleet_smoke.py: two" \
      "processes federate + stitch, one dies, journals identical)" >&2
 python scripts/fleet_smoke.py > "$workdir/fleet.json"
+
+echo "[preflight 7/7] disagg smoke (scripts/disagg_smoke.py: prefill" \
+     "+ 2 decode processes, kill + resume, token-identical twice)" >&2
+python scripts/disagg_smoke.py > "$workdir/disagg.json"
 
 echo "[preflight] PASS" >&2

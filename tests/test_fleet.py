@@ -415,6 +415,63 @@ def test_stats_providers_feed_heartbeat_and_survive_errors():
         fleet.clear_stats_providers()
 
 
+def test_self_descriptor_refreshes_member_row():
+    """Regression pin (ISSUE 17 satellite): the heartbeat descriptor
+    must sample pool stats AT ANNOUNCE TIME and refresh self's stored
+    member row. Before the fix, self's row held the boot-time snapshot
+    forever — a degrade-ladder controller mid-walk was invisible to
+    /fleet/members and fleetctl."""
+    level = {"v": 0}
+    fleet.clear_stats_providers()
+    try:
+        fleet.add_stats_provider(lambda: {"m": {"degrade_level": level["v"]}})
+        now = [100.0]
+        reg = _registry(now)
+        row = next(m for m in reg.members() if m["self"])
+        assert row["pools"]["m"]["degrade_level"] == 0
+        level["v"] = 2  # the ladder walks between heartbeats
+        reg.self_descriptor()
+        row = next(m for m in reg.members() if m["self"])
+        assert row["pools"]["m"]["degrade_level"] == 2
+    finally:
+        fleet.clear_stats_providers()
+
+
+def test_gprefix_and_kvx_addr_piggyback_on_heartbeat():
+    """The fleet data plane rides the EXISTING heartbeat: digest
+    providers and the transfer endpoint land in the descriptor and in
+    the membership rows peers score against."""
+    digest = {"m": {"page": 32, "tails": {"ab12cd34ef567890": 3}}}
+    fleet.clear_digest_providers()
+    try:
+        fleet.add_digest_provider(lambda: digest)
+        fleet.set_transfer_addr("1.2.3.4:9400")
+        now = [100.0]
+        reg = _registry(now)
+        desc = reg.self_descriptor()
+        assert desc["gprefix"] == digest
+        assert desc["kvx_addr"] == "1.2.3.4:9400"
+        row = next(m for m in reg.members() if m["self"])
+        assert row["gprefix"] == digest
+        assert row["kvx_addr"] == "1.2.3.4:9400"
+    finally:
+        fleet.clear_digest_providers()
+        fleet.set_transfer_addr("")
+
+
+def test_digest_provider_errors_survive():
+    def bad():
+        raise RuntimeError("sick engine")
+
+    fleet.clear_digest_providers()
+    try:
+        fleet.add_digest_provider(bad)
+        digest = fleet._self_gprefix()
+        assert "provider" in digest["_error"]
+    finally:
+        fleet.clear_digest_providers()
+
+
 # -- the multihost env contract ---------------------------------------------
 
 
@@ -533,6 +590,62 @@ def test_scenario_endpoints_field_parses():
         "tenants": [{"name": "chat"}],
     }, "inline")
     assert sc.endpoints == ("127.0.0.1:1", "127.0.0.1:2")
+
+
+# -- fleetctl --json (ISSUE 17 satellite) -----------------------------------
+
+
+def _fleetctl():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleetctl", os.path.join(REPO, "scripts", "fleetctl.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleetctl_json_status_and_top(capsys):
+    """``--json`` replaces the terse verdict with the full row set —
+    same fields the table renders (plus kvx_addr), same exit codes."""
+    mod = _fleetctl()
+    data = {
+        "members": [
+            {"host": "hostA", "role": "runtime", "state": "up",
+             "age_secs": 0.1, "rank": "0", "version": "t", "pid": 1,
+             "metrics_addr": "a:1", "kvx_addr": "a:2", "self": True,
+             "pools": {"m": {"waiting": 1, "batch_occupancy": 0.5,
+                             "degrade_level": 2}},
+             "slo": {"worst_burn": 1.5}},
+            {"host": "hostB", "role": "decode", "state": "suspect",
+             "age_secs": 7.0, "rank": "1", "version": "t", "pid": 2,
+             "metrics_addr": "b:1", "kvx_addr": "b:2", "self": False,
+             "pools": {}, "slo": {}},
+        ],
+        "journal": [{"host": "hostB", "role": "decode", "from": "up",
+                     "to": "suspect", "at": 0.0}],
+    }
+    rc = mod.cmd_status(data, as_json=True)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and out["pass"] is False
+    assert out["size"] == 2 and out["up"] == 1
+    assert out["members"][0]["kvx_addr"] == "a:2"
+    assert out["journal"][0]["to"] == "suspect"
+    rc = mod.cmd_top(data, as_json=True)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and out["pass"] is False
+    # worst burn sorts first, load triple flattened per row
+    assert out["members"][0]["host"] == "hostA"
+    assert out["members"][0]["worst_burn"] == 1.5
+    assert out["members"][0]["degrade_level"] == 2
+    assert out["members"][0]["waiting"] == 1
+    # the terse verdict path is unchanged
+    rc = mod.cmd_status(data)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and out["not_up"] == [
+        {"host": "hostB", "role": "decode", "state": "suspect"}
+    ]
 
 
 # -- the two-process e2e (slow tier) ----------------------------------------

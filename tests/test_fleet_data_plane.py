@@ -1,0 +1,540 @@
+"""Fleet data plane acceptance (aios_tpu/fleet/, ISSUE 17).
+
+Fast CPU tier, tiny engines. Three layers:
+
+  1. Wire-format / store units: ``pack_entry``/``unpack_entry`` round
+     trips (int8-scale pages byte-exact), receiving-end crc32 tamper
+     detection, and ``HostPageStore.export_chain``'s sender-side
+     recheck.
+  2. Gossip + routing units: prefix-chain scoring off an advertised
+     digest, peer filtering, and the fleet router's gain/cost gates.
+  3. THE two-host acceptance: "hosts" are separate ReplicaPools with
+     identically-seeded weights behind real gRPC KvTransfer servers in
+     one process — a prompt prefilled on host A decodes on host B
+     token-identically to a single-host run, including across a seeded
+     decode-host kill (re-handoff to the survivor) and across
+     failed/corrupt transfers (local-prefill fallback, failure
+     counted).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from aios_tpu.engine import paged
+from aios_tpu.fleet import disagg, gprefix, kvx
+from aios_tpu.obs import instruments as obs
+
+
+MODEL = "fleet-dp-test"
+PAGE = 32
+
+
+# ---------------------------------------------------------------------------
+# 1. wire format + store export (no engines)
+# ---------------------------------------------------------------------------
+
+
+def _entry(seed=0, dtype=np.float32, scales=False):
+    rng = np.random.default_rng(seed)
+    if dtype == np.int8:
+        e = {
+            "k": rng.integers(-128, 127, (2, 4, PAGE, 8), dtype=np.int8),
+            "v": rng.integers(-128, 127, (2, 4, PAGE, 8), dtype=np.int8),
+        }
+    else:
+        e = {
+            "k": rng.standard_normal((2, 4, PAGE, 8)).astype(dtype),
+            "v": rng.standard_normal((2, 4, PAGE, 8)).astype(dtype),
+        }
+    if scales:
+        e["k_s"] = rng.standard_normal((2, 4, PAGE)).astype(np.float32)
+        e["v_s"] = rng.standard_normal((2, 4, PAGE)).astype(np.float32)
+    return e
+
+
+def _h(i):
+    return bytes([i]) * 32
+
+
+def test_pack_unpack_round_trip_byte_exact():
+    e = _entry(1)
+    out = paged.unpack_entry(paged.pack_entry(e))
+    assert sorted(out) == sorted(e)
+    for k in e:
+        assert out[k].dtype == e[k].dtype
+        assert out[k].shape == e[k].shape
+        assert np.array_equal(out[k], e[k])
+        assert out[k].flags["WRITEABLE"]  # host_store.corrupt needs this
+    # the crc (the transfer plane's integrity token) survives the trip
+    assert (paged.HostPageStore._entry_crc(out)
+            == paged.HostPageStore._entry_crc(e))
+
+
+def test_int8_scale_pages_survive_byte_exact():
+    """Quantized-cache entries (int8 KV + float32 scales) must cross
+    the wire byte-exact: a single flipped scale byte rescales a whole
+    page of keys."""
+    e = _entry(2, dtype=np.int8, scales=True)
+    out = paged.unpack_entry(paged.pack_entry(e))
+    assert out["k"].dtype == np.int8 and out["v"].dtype == np.int8
+    for k in ("k", "v", "k_s", "v_s"):
+        assert out[k].tobytes() == e[k].tobytes()
+
+
+def test_unpack_rejects_damaged_framing():
+    payload = paged.pack_entry(_entry(3))
+    with pytest.raises(ValueError):
+        paged.unpack_entry(b"XXXX" + payload[4:])  # bad magic
+    with pytest.raises(ValueError):
+        paged.unpack_entry(payload[:-7])  # truncated payload
+    with pytest.raises(ValueError):
+        paged.unpack_entry(payload + b"\x00")  # trailing bytes
+
+
+def test_verify_entry_detects_tamper_at_receiving_end():
+    """The RECEIVING end re-derives the crc from the unpacked arrays —
+    a bit flipped anywhere in transit (or in the sender's host RAM
+    after the crc was stamped) fails verification."""
+    from aios_tpu.proto_gen import fleet_pb2
+
+    e = _entry(4)
+    payload = paged.pack_entry(e)
+    crc = paged.HostPageStore._entry_crc(e)
+    good = fleet_pb2.PageEntry(hash=_h(1), crc32=crc, payload=payload)
+    assert sorted(kvx.verify_entry(good)) == sorted(e)
+    # flip the LAST byte: lands inside array data, so framing still
+    # parses and only the checksum can catch it
+    tampered = payload[:-1] + bytes([payload[-1] ^ 0xFF])
+    bad = fleet_pb2.PageEntry(hash=_h(1), crc32=crc, payload=tampered)
+    with pytest.raises(kvx.CrcMismatch):
+        kvx.verify_entry(bad)
+
+
+def test_export_chain_round_trip_and_budget():
+    store = paged.HostPageStore(32 << 20)
+    entries = {_h(i): _entry(i) for i in (1, 2, 3)}
+    for h, e in entries.items():
+        store.put(h, e)
+    chain = [_h(1), _h(2), _h(3)]
+    out = store.export_chain(chain)
+    assert [h for h, _, _ in out] == chain
+    for h, crc, e in out:
+        assert crc == paged.HostPageStore._entry_crc(e)
+        # entry -> wire bytes -> entry, byte-exact
+        rt = paged.unpack_entry(paged.pack_entry(e))
+        for k in e:
+            assert np.array_equal(rt[k], entries[h][k])
+    one = paged.HostPageStore._entry_bytes(entries[_h(1)])
+    assert len(store.export_chain(chain, budget_bytes=one)) == 1
+    # a hole truncates the chain (transfer past a gap restores nothing)
+    assert [h for h, _, _ in store.export_chain([_h(1), _h(9), _h(3)])] \
+        == [_h(1)]
+
+
+def test_export_chain_drops_rotten_entry():
+    """Sender-side half of verified-at-both-ends: host-RAM rot since
+    the spill is caught BEFORE the page ships."""
+    store = paged.HostPageStore(32 << 20)
+    for i in (1, 2, 3):
+        store.put(_h(i), _entry(i))
+    with store._lock:
+        store._entries[_h(2)]["k"].flat[0] += 1.0  # the rot
+    out = store.export_chain([_h(1), _h(2), _h(3)])
+    assert [h for h, _, _ in out] == [_h(1)]
+    assert store.corruptions == 1
+    assert store.peek_chain([_h(1), _h(2)]) == 1  # rotten entry evicted
+
+
+# ---------------------------------------------------------------------------
+# 2. gossip + scoring units
+# ---------------------------------------------------------------------------
+
+
+def _digest_for(hashes, page=PAGE, depth_from=1):
+    return {
+        "page": page,
+        "tails": {gprefix.tail(h): depth_from + i
+                  for i, h in enumerate(hashes)},
+    }
+
+
+def test_score_tails_is_prefix_not_membership():
+    chain = [_h(1), _h(2), _h(3), _h(4)]
+    assert gprefix.score_tails(_digest_for(chain), chain) == 4 * PAGE
+    # a hole at block 2 makes the advertised blocks 3/4 unreachable
+    holed = _digest_for([chain[0], chain[2], chain[3]])
+    assert gprefix.score_tails(holed, chain) == 1 * PAGE
+    assert gprefix.score_tails({}, chain) == 0
+    assert gprefix.score_tails(_digest_for(chain), []) == 0
+    assert gprefix.score_tails({"page": 0, "tails": {"ab": 1}}, chain) == 0
+
+
+def test_best_peer_filters_dead_self_and_addressless():
+    chain = [_h(1), _h(2), _h(3)]
+    full = {MODEL: _digest_for(chain)}
+    shallow = {MODEL: _digest_for(chain[:1])}
+    peers = [
+        {"host": "dead", "state": "dead", "kvx_addr": "a:1",
+         "gprefix": full},
+        {"host": "me", "state": "up", "self": True, "kvx_addr": "a:2",
+         "gprefix": full},
+        {"host": "mute", "state": "up", "kvx_addr": "",
+         "gprefix": full},
+        {"host": "shallow", "state": "up", "kvx_addr": "a:3",
+         "gprefix": shallow},
+        {"host": "deep", "state": "up", "kvx_addr": "a:4",
+         "gprefix": full},
+    ]
+    peer, rows = gprefix.best_peer(peers, MODEL, chain)
+    assert peer["host"] == "deep" and rows == 3 * PAGE
+    assert gprefix.best_peer(peers[:3], MODEL, chain) == (None, 0)
+
+
+# ---------------------------------------------------------------------------
+# 3. two-host acceptance rig: real engines, real gRPC, one process
+# ---------------------------------------------------------------------------
+
+
+class _MM:
+    """ManagedModel stand-in: exactly the surface the fleet plane uses."""
+
+    def __init__(self, name, engine, pool):
+        self.name, self.engine, self.pool = name, engine, pool
+
+    def submit(self, req, tenant="anonymous", deadline_s=None):
+        return self.pool.submit(req, tenant=tenant, deadline_s=deadline_s)
+
+
+class _Mgr:
+    def __init__(self, models):
+        self._models = models
+
+    def get(self, name):
+        return self._models.get(name)
+
+    def ready_models(self):
+        return list(self._models.values())
+
+
+class _Rig:
+    """One 'fleet' in one process: prefill host A plus decode hosts B
+    and C, each a 1-replica pool over identically-seeded weights (greedy
+    streams are therefore comparable across hosts), B and C behind real
+    KvTransfer gRPC servers on ephemeral ports."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        from aios_tpu import rpc, services
+        from aios_tpu.engine import model as model_mod
+        from aios_tpu.engine.batching import ContinuousBatcher
+        from aios_tpu.engine.config import TINY_TEST
+        from aios_tpu.engine.engine import TPUEngine
+        from aios_tpu.serving import ReplicaPool, ServingConfig
+
+        cfg = TINY_TEST.scaled(name=MODEL, max_context=256)
+        params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                       dtype=jnp.float32)
+        self.mms = {}
+        self.servers = []
+        self.addrs = {}
+        for host in ("hostA", "hostB", "hostC"):
+            engine = TPUEngine(
+                cfg, params, num_slots=2, max_context=256,
+                cache_dtype=jnp.float32, paged_pool_rows=256,
+                page_size=PAGE, prefix_host_bytes=32 << 20,
+            )
+            pool = ReplicaPool(
+                MODEL, [engine],
+                lambda e: ContinuousBatcher(e, chunk_steps=2,
+                                            admit_chunk_steps=2),
+                ServingConfig(replicas=1, failover_retries=2),
+            )
+            mm = _MM(MODEL, engine, pool)
+            self.mms[host] = mm
+            if host != "hostA":
+                server = rpc.create_server(max_workers=8)
+                rpc.add_to_server(
+                    services.KVTRANSFER,
+                    disagg.DisaggService(_Mgr({MODEL: mm})), server,
+                )
+                port = server.add_insecure_port("127.0.0.1:0")
+                server.start()
+                self.servers.append(server)
+                self.addrs[host] = f"127.0.0.1:{port}"
+        kvx.register_kvx_metrics(MODEL)
+        from aios_tpu.fleet.router import register_route_metrics
+
+        register_route_metrics(MODEL)
+        self.plane = disagg.DisaggPlane(_Mgr({MODEL: self.mms["hostA"]}))
+        self.plane._members = self.members  # instance attr shadows method
+
+    def members(self, hosts=("hostB", "hostC")):
+        return [
+            {"host": h, "role": "decode", "state": "up", "self": False,
+             "kvx_addr": self.addrs[h], "pools": {}, "gprefix": {}}
+            for h in hosts
+        ]
+
+    def shutdown(self):
+        kvx.reset_channels()
+        for s in self.servers:
+            s.stop(grace=0.2)
+        for mm in self.mms.values():
+            mm.pool.shutdown()
+
+
+@pytest.fixture(scope="module")
+def rig():
+    r = _Rig()
+    yield r
+    r.shutdown()
+
+
+def _prompt(seed, n=100):
+    return [(seed * 131 + i * 7) % 500 + 1 for i in range(n)]
+
+
+def _req(seed, rid, max_tokens=24):
+    from aios_tpu.engine.batching import Request
+
+    return Request(prompt_ids=_prompt(seed), max_tokens=max_tokens,
+                   temperature=0.0, request_id=rid)
+
+
+def _counter(metric, **labels):
+    return metric.labels(**labels).value
+
+
+def test_router_gain_and_peer_gates(rig):
+    """decide_pull walks local -> no_peer -> remote_pull off the
+    gossiped digests: a peer promising the full chain wins an uncached
+    prompt; no advertising peer on a cold prompt is ``no_peer``."""
+    mm = rig.mms["hostA"]
+    route_ids = _prompt(90)
+    chain = mm.engine.prefix_hashes(route_ids)
+    assert len(chain) == (len(route_ids) - 1) // PAGE
+    router = rig.plane.router
+    rows = rig.members(("hostB",))
+    rows[0]["gprefix"] = {MODEL: _digest_for(chain)}
+    router._peers = lambda: rows
+    reason, detail = router.decide_pull(mm, route_ids)
+    assert reason == "remote_pull"
+    assert detail["addr"] == rig.addrs["hostB"]
+    assert detail["hashes"] == chain[: max(detail["rows"] // PAGE, 1)]
+    # nobody advertises overlap and the local cache is cold: no_peer
+    router._peers = lambda: rig.members(("hostB",))
+    assert router.decide_pull(mm, route_ids)[0] == "no_peer"
+    del router._peers
+
+
+def test_kvx_push_then_fetch_round_trip_over_grpc(rig):
+    """Pages pushed into host B's spill tier come back byte-exact
+    through a Fetch — the full wire round trip, both ends verifying."""
+    addr = rig.addrs["hostB"]
+    store_b = rig.mms["hostB"].engine.host_store
+    chain = [_h(0x21), _h(0x22), _h(0x23)]
+    pairs = [(h, _entry(i + 10)) for i, h in enumerate(chain)]
+    before = _counter(obs.FLEET_KVX_PAGES, model=MODEL, direction="push")
+    assert kvx.push_chain(addr, MODEL, pairs) == 3
+    assert _counter(obs.FLEET_KVX_PAGES, model=MODEL,
+                    direction="push") == before + 3
+    assert store_b.peek_chain(chain) == 3
+    got = kvx.fetch_chain(addr, MODEL, chain)
+    assert [h for h, _ in got] == chain
+    for (h, e), (_, sent) in zip(got, pairs):
+        for k in sent:
+            assert np.array_equal(e[k], sent[k])
+    store_b.discard(chain)
+
+
+def test_kvx_push_tamper_rejected_at_receiver(rig):
+    """A payload corrupted in transit is rejected by the RECEIVER's crc
+    re-derivation: counted on the closed cause enum, never stored."""
+    addr = rig.addrs["hostB"]
+    store_b = rig.mms["hostB"].engine.host_store
+    e = _entry(30)
+    payload = paged.pack_entry(e)
+    crc = paged.HostPageStore._entry_crc(e)
+    tampered = payload[:-1] + bytes([payload[-1] ^ 0xFF])
+    before = _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                      cause="crc_mismatch")
+    ack = kvx._stub(addr).Push(
+        kvx.entries_to_chunks(MODEL, [(_h(0x31), crc, tampered)])
+    )
+    assert (ack.accepted, ack.rejected) == (0, 1)
+    assert _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                    cause="crc_mismatch") == before + 1
+    assert store_b.peek_chain([_h(0x31)]) == 0
+
+
+def test_kvx_fetch_failure_causes(rig):
+    """An unfulfilled promise counts ``empty``; an unreachable peer
+    counts the transport cause — both return [] (the caller falls back
+    to local prefill), never raise."""
+    before = _counter(obs.FLEET_KVX_FAILURES, model=MODEL, cause="empty")
+    assert kvx.fetch_chain(rig.addrs["hostB"], MODEL, [_h(0x41)]) == []
+    assert _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                    cause="empty") == before + 1
+    before = _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                      cause="unavailable")
+    assert kvx.fetch_chain("127.0.0.1:1", MODEL, [_h(0x42)]) == []
+    assert _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                    cause="unavailable") == before + 1
+
+
+# -- THE acceptance: disaggregated streams are token-identical ---------------
+
+
+def _ref_tokens(rig, seed, rid, max_tokens=24):
+    h = rig.mms["hostA"].submit(_req(seed, rid, max_tokens))
+    toks = h.tokens()
+    assert not h.aborted and len(toks) == max_tokens
+    return toks
+
+
+def test_handoff_stream_token_identical_across_hosts(rig):
+    """ISSUE 17 acceptance: prompt prefilled on host A decodes on host
+    B with a token stream identical to a single-host run — first token
+    from A's prefill, the rest relayed over the Handoff stream, KV
+    pushed ahead over the kvx plane."""
+    ref = _ref_tokens(rig, 50, "dp-ref-0")
+    pushed_before = _counter(obs.FLEET_KVX_PAGES, model=MODEL,
+                             direction="push")
+    handoffs_before = _counter(obs.FLEET_ROUTE, model=MODEL,
+                               reason="handoff")
+    handle = disagg.HandoffHandle(
+        rig.plane, rig.mms["hostA"], _req(50, "dp-handoff-0"), "t", None,
+    )
+    out = handle.tokens()
+    assert out == ref, "disaggregated stream must be token-identical"
+    assert not handle.aborted
+    assert handle.ttft_ms > 0.0
+    assert _counter(obs.FLEET_ROUTE, model=MODEL,
+                    reason="handoff") == handoffs_before + 1
+    # the prefix chain actually crossed hosts ((prompt-1)//page pages)
+    assert _counter(obs.FLEET_KVX_PAGES, model=MODEL, direction="push") \
+        >= pushed_before + (len(_prompt(50)) - 1) // PAGE
+
+
+def test_handoff_survives_decode_host_kill(rig):
+    """A seeded ``fleet.host_kill`` aborts decode host B mid-stream;
+    host A re-hands prompt + ALL emitted tokens to survivor C and the
+    client stream is still token-identical — tokens already relayed are
+    never re-sent."""
+    from aios_tpu.faults import inject as faults
+
+    ref = _ref_tokens(rig, 51, "dp-ref-kill")
+    resumed_before = _counter(obs.FLEET_ROUTE, model=MODEL,
+                              reason="handoff_resume")
+    faults.activate("seed=1;fleet.host_kill=nth:3")
+    try:
+        handle = disagg.HandoffHandle(
+            rig.plane, rig.mms["hostA"], _req(51, "dp-kill-0"), "t", None,
+        )
+        out = handle.tokens()
+    finally:
+        faults.deactivate()
+    assert out == ref, "kill-and-resume stream must be token-identical"
+    assert not handle.aborted
+    assert _counter(obs.FLEET_ROUTE, model=MODEL,
+                    reason="handoff_resume") == resumed_before + 1
+
+
+def test_failed_push_and_pull_fall_back_to_local_prefill(rig,
+                                                         monkeypatch):
+    """Corrupt/failed-transfer contract: the push 'fails' (0 accepted)
+    and the decode host's pull-on-miss hits a dead source — it simply
+    recomputes the prefill locally (PR 10 restore_fail, one hop out).
+    The stream is still token-identical and the failure is counted."""
+    from aios_tpu.obs import fleet as obs_fleet
+
+    ref = _ref_tokens(rig, 52, "dp-ref-fb")
+    monkeypatch.setattr(kvx, "push_chain", lambda *a, **k: 0)
+    obs_fleet.set_transfer_addr("127.0.0.1:1")  # dead source for the pull
+    fail_before = _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                           cause="unavailable")
+    try:
+        handle = disagg.HandoffHandle(
+            rig.plane, rig.mms["hostA"], _req(52, "dp-fb-0"), "t", None,
+        )
+        out = handle.tokens()
+    finally:
+        obs_fleet.set_transfer_addr("")
+    assert out == ref, "failed transfer must not change the stream"
+    assert not handle.aborted
+    assert _counter(obs.FLEET_KVX_FAILURES, model=MODEL,
+                    cause="unavailable") > fail_before
+
+
+def test_no_decode_peer_falls_back_to_local_decode(rig, monkeypatch):
+    """The whole decode tier gone: the prefill host finishes the stream
+    itself off the resume-from-emitted contract, counted
+    ``fallback_local``."""
+    ref = _ref_tokens(rig, 53, "dp-ref-solo")
+    monkeypatch.setattr(rig.plane, "_members", lambda: [])
+    fb_before = _counter(obs.FLEET_ROUTE, model=MODEL,
+                         reason="fallback_local")
+    handle = disagg.HandoffHandle(
+        rig.plane, rig.mms["hostA"], _req(53, "dp-solo-0"), "t", None,
+    )
+    out = handle.tokens()
+    assert out == ref
+    assert not handle.aborted
+    assert _counter(obs.FLEET_ROUTE, model=MODEL,
+                    reason="fallback_local") == fb_before + 1
+
+
+def test_route_submit_degrades_to_plain_submit_when_disarmed(rig):
+    """Solo hosts keep the exact pre-fleet path: with the plane
+    disarmed, route_submit IS m.submit."""
+    assert disagg.PLANE is None
+    handle = disagg.route_submit(rig.mms["hostA"], _req(54, "dp-plain-0"))
+    assert handle.tokens() == _ref_tokens(rig, 54, "dp-plain-ref")
+
+
+def test_pick_decode_prefers_least_loaded_and_excludes(rig):
+    rows = rig.members()
+    rows[0]["pools"] = {MODEL: {"occupancy": 0.9, "waiting": 3}}
+    plane = rig.plane
+    orig = plane._members
+    plane._members = lambda: rows
+    try:
+        host, addr = plane.pick_decode(MODEL)
+        assert (host, addr) == ("hostC", rig.addrs["hostC"])
+        host, _ = plane.pick_decode(MODEL, exclude=["hostC"])
+        assert host == "hostB"
+        assert plane.pick_decode(MODEL,
+                                 exclude=["hostB", "hostC"]) is None
+    finally:
+        plane._members = orig
+
+
+def test_handoff_concurrent_streams(rig):
+    """Several disaggregated streams in flight at once (the decode host
+    batches them) all stay token-identical."""
+    seeds = (60, 61, 62)
+    refs = [_ref_tokens(rig, s, f"dp-ref-c{s}", max_tokens=16)
+            for s in seeds]
+    handles = [
+        disagg.HandoffHandle(
+            rig.plane, rig.mms["hostA"],
+            _req(s, f"dp-conc-{s}", max_tokens=16), "t", None,
+        )
+        for s in seeds
+    ]
+    out = {}
+    threads = [
+        threading.Thread(target=lambda i=i, h=h: out.__setitem__(
+            i, h.tokens()), daemon=True)
+        for i, h in enumerate(handles)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "a disaggregated stream leaked"
+    assert [out[i] for i in range(len(seeds))] == refs

@@ -506,6 +506,9 @@ def test_recorder_event_kinds_bounded():
     flightrec.EVENT_KINDS enum."""
     from aios_tpu.engine import batching, engine as engine_mod
     from aios_tpu.faults import inject as faults_inject
+    from aios_tpu.fleet import disagg as fleet_disagg
+    from aios_tpu.fleet import kvx as fleet_kvx
+    from aios_tpu.fleet import router as fleet_router
     from aios_tpu.obs import fleet, flightrec
     from aios_tpu.runtime import service as runtime_service
     from aios_tpu.serving import autoscale, failover, pool
@@ -513,6 +516,7 @@ def test_recorder_event_kinds_bounded():
     kinds = _call_site_kinds(
         batching, engine_mod, pool, runtime_service, flightrec,
         failover, faults_inject, autoscale, fleet,
+        fleet_disagg, fleet_kvx, fleet_router,
     )
     assert kinds, "no recorder event call sites found"
     unknown = kinds - set(flightrec.EVENT_KINDS)
@@ -675,32 +679,34 @@ def test_autoscale_enums_closed_and_iterated_at_registration():
 
 # -- the fleet telemetry family (obs/fleet.py, ISSUE 16) -------------------
 
+# Every aios_tpu_fleet_* family, pinned name -> (kind, labelnames):
+# the ISSUE 16 membership plane carries (host, role) — the per-process
+# identity axes — while the ISSUE 17 data plane (kvx transfers, fleet
+# routing) carries model plus ONE closed-enum dimension, the serving
+# metric convention. Any NEW fleet metric must be added here (and to
+# docs/OBSERVABILITY.md) so the family stays reviewed.
 FLEET_EXPECTED = {
-    "aios_tpu_fleet_member_up_total": "gauge",
-    "aios_tpu_fleet_member_transitions_total": "counter",
-    "aios_tpu_fleet_scrape_failures_total": "counter",
+    "aios_tpu_fleet_member_up_total": ("gauge", ("host", "role")),
+    "aios_tpu_fleet_member_transitions_total": (
+        "counter", ("host", "role", "state")),
+    "aios_tpu_fleet_scrape_failures_total": ("counter", ("host", "role")),
+    "aios_tpu_fleet_kvx_pages_total": ("counter", ("model", "direction")),
+    "aios_tpu_fleet_kvx_bytes_total": ("counter", ("model", "direction")),
+    "aios_tpu_fleet_kvx_failures_total": ("counter", ("model", "cause")),
+    "aios_tpu_fleet_route_total": ("counter", ("model", "reason")),
 }
 
 
 def test_fleet_family_complete_and_typed():
-    """The fleet-plane instruments the ISSUE 16 catalog promises exist,
-    with the promised kinds — and any NEW aios_tpu_fleet_* metric must
-    be added here (and to docs/OBSERVABILITY.md) so the family stays
-    reviewed. member_up/scrape_failures carry exactly (host, role);
-    ONLY the transition counter adds the state dimension, and its
-    values come from the closed MEMBER_STATES enum."""
+    """The fleet-plane instruments the ISSUE 16/17 catalogs promise
+    exist with the promised kinds AND exactly the pinned label sets —
+    membership metrics on (host, role), data-plane metrics on (model,
+    <closed enum>). An unreviewed aios_tpu_fleet_* metric fails here."""
     family = {
-        m.name: m.kind for m in _catalog()
+        m.name: (m.kind, tuple(m.labelnames)) for m in _catalog()
         if m.name.startswith("aios_tpu_fleet_")
     }
     assert family == FLEET_EXPECTED
-    for m in _catalog():
-        if m.name == "aios_tpu_fleet_member_transitions_total":
-            assert tuple(m.labelnames) == ("host", "role", "state")
-        elif m.name.startswith("aios_tpu_fleet_"):
-            assert tuple(m.labelnames) == ("host", "role"), (
-                f"{m.name}: fleet metrics carry exactly (host, role)"
-            )
 
 
 def test_fleet_member_states_closed_and_iterated_at_registration():
@@ -723,6 +729,40 @@ def test_fleet_member_states_closed_and_iterated_at_registration():
     # may only worsen a state) — it must read the same tuple
     tick = mi.functions["FleetRegistry.tick"]
     assert "MEMBER_STATES" in names_used_in(tick.node)
+
+
+def test_fleet_kvx_and_route_enums_closed_and_iterated_at_registration():
+    """The data-plane label values come from the closed enum tuples and
+    nowhere else: ``direction``/``cause`` from kvx.KVX_DIRECTIONS /
+    KVX_FAIL_CAUSES, ``reason`` from router.FLEET_ROUTE_REASONS — and
+    each registration helper pre-registers every child by iterating its
+    enum (the MEMBER_STATES/autoscale pattern), so a new transfer
+    failure mode or routing outcome is a reviewed enum change, never a
+    stray label value."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu.fleet import kvx, router
+
+    assert kvx.KVX_DIRECTIONS == ("push", "pull")
+    assert kvx.KVX_FAIL_CAUSES == (
+        "unavailable", "timeout", "crc_mismatch", "decode_error", "empty",
+    )
+    assert router.FLEET_ROUTE_REASONS == (
+        "local", "no_peer", "remote_pull", "handoff", "handoff_resume",
+        "fallback_local",
+    )
+    kmi = module_info_for(kvx)
+    used = names_used_in(kmi.functions["register_kvx_metrics"].node)
+    assert "KVX_DIRECTIONS" in used and "KVX_FAIL_CAUSES" in used, (
+        "kvx metric children must be pre-registered by iterating the "
+        "closed enums"
+    )
+    rmi = module_info_for(router)
+    assert "FLEET_ROUTE_REASONS" in names_used_in(
+        rmi.functions["register_route_metrics"].node
+    ), (
+        "route metric children must be pre-registered by iterating "
+        "FLEET_ROUTE_REASONS"
+    )
 
 
 def test_process_info_gauge_is_an_identity_series():
