@@ -89,6 +89,17 @@ LOCKS: Tuple[LockDecl, ...] = (
     # disaggregated stream — the transfer RPCs themselves (push, fetch,
     # the handoff stream) always run outside it
     LockDecl("handoff", "aios_tpu.fleet.disagg", "HandoffHandle", "_lock"),
+    # quarantine: per-peer breaker bookkeeping (EWMAs, state, probe
+    # budget) — the cross-host calls whose outcomes feed it always run
+    # outside, and metric/recorder emission for state edges happens
+    # after release (no quarantine->recorder lock edge)
+    LockDecl("quarantine", "aios_tpu.fleet.breaker", "BreakerBoard",
+             "_lock"),
+    # drain: the phase flag and the one-shot worker handle — the drain
+    # protocol itself (pool drain, kvx pushes, the leaving announce)
+    # runs on its worker thread outside the lock
+    LockDecl("drain", "aios_tpu.fleet.drain", "DrainCoordinator",
+             "_lock"),
 )
 
 

@@ -34,8 +34,14 @@ run a *regression test* instead of a dice roll):
     activation (wall-clock; for live chaos drills, not determinism).
 
 Optional ``key=value`` params ride after the trigger: ``delay_ms``
-(dispatch.delay), ``skew_ms`` (admission.clock_skew), ``retry_after_ms``
-(rpc.unavailable).
+(dispatch.delay, net.delay), ``skew_ms`` (admission.clock_skew),
+``retry_after_ms`` (rpc.unavailable), ``after_msgs`` (net.drop_after).
+The ``net.*`` points additionally take STRING-valued scoping params —
+``src=``/``dst=`` (fleet host ids) and ``surface=`` ("rpc"/"http") —
+and count hits per ``(src, dst)`` edge, so the k-th send on one edge
+fires deterministically regardless of other edges' traffic; ``until=M``
+widens an ``nth:N`` one-shot into the held window ``[N, M]``
+(docs/FAULTS.md "Per-edge network faults").
 
 Every fired fault is counted by ``aios_tpu_faults_injected_total{point,
 mode}``, recorded on the flight recorder's model lane as a ``fault``
@@ -81,6 +87,17 @@ POINTS = (
     # process (the disagg smoke's real host kill) — and the prefill
     # host re-hands the stream to a survivor
     "fleet.host_kill",
+    # per-EDGE network faults (aios_tpu/faults/net.py): scoped by
+    # src=/dst= host-id params (string-valued) and an optional
+    # surface= filter ("rpc" | "http"), hit-counted PER EDGE so the
+    # k-th send on one edge fires deterministically no matter how
+    # other edges interleave. Injected at the shared rpc client
+    # interceptor and the obs/fleet.py HTTP helpers — membership,
+    # federation, KVX, and Handoff all traverse one fault surface.
+    "net.partition",          # both directions refused
+    "net.partition_oneway",   # src->dst dropped, reverse clean
+    "net.delay",              # per-edge latency (delay_ms)
+    "net.drop_after",         # stream severed after after_msgs messages
 )
 
 MODES = ("nth", "prob", "after")
@@ -96,7 +113,14 @@ _PARAM_DEFAULTS: Dict[str, Dict[str, float]] = {
     "dispatch.delay": {"delay_ms": 10.0},
     "admission.clock_skew": {"skew_ms": 1000.0},
     "rpc.unavailable": {"retry_after_ms": 1000.0},
+    "net.delay": {"delay_ms": 50.0},
+    "net.drop_after": {"after_msgs": 3.0},
 }
+
+# param keys whose values are strings, not floats — the per-edge scoping
+# of the net.* points. Any OTHER non-float param value still drops the
+# whole entry (the lenient-env contract tests pin).
+_STR_PARAMS = ("src", "dst", "surface")
 
 
 class InjectedFault(RuntimeError):
@@ -121,6 +145,9 @@ class FaultAction:
     # smoke's real host kill. Default False so in-process tests drive
     # the same recovery path without dying.
     exit: bool = False
+    # net.drop_after only: how many stream messages flow before the
+    # sever (the mid-transfer cut the resume ladder must survive)
+    after_msgs: int = 3
 
 
 @dataclass
@@ -128,6 +155,8 @@ class _PointSpec:
     mode: str
     arg: float  # N for nth, P for prob, T seconds for after
     params: Dict[str, float] = field(default_factory=dict)
+    # string-valued params (src/dst/surface) — the net.* edge scoping
+    strs: Dict[str, str] = field(default_factory=dict)
 
 
 class FaultPlan:
@@ -149,17 +178,49 @@ class FaultPlan:
             name: random.Random(f"{seed}:{name}") for name in schedule
         }
 
-    def check(self, name: str, model: str = "") -> Optional[FaultAction]:
+    def check(self, name: str, model: str = "",
+              edge: Optional[Tuple[str, str]] = None,
+              surface: str = "") -> Optional[FaultAction]:
         spec = self.schedule.get(name)
         if spec is None:
             return None
+        # edge/surface scoping (net.* points): a spec scoped to a
+        # src/dst/surface it does not match neither fires NOR consumes
+        # a hit — unrelated traffic must not shift the hit index the
+        # determinism contract anchors on.
+        want_src = spec.strs.get("src", "")
+        want_dst = spec.strs.get("dst", "")
+        if want_src or want_dst:
+            if edge is None:
+                return None
+            if want_src and edge[0] != want_src:
+                return None
+            if want_dst and edge[1] != want_dst:
+                return None
+        want_surface = spec.strs.get("surface", "")
+        if want_surface and surface != want_surface:
+            return None
+        # per-edge points count hits PER EDGE: the k-th send on one
+        # edge is the same k no matter how other edges interleave
+        key = name if edge is None else f"{name}|{edge[0]}->{edge[1]}"
         with self._lock:
-            hit = self._hits.get(name, 0) + 1
-            self._hits[name] = hit
+            hit = self._hits.get(key, 0) + 1
+            self._hits[key] = hit
             if spec.mode == "nth":
-                fire = hit == int(spec.arg)
+                # until=M widens the one-shot to the window [N, M] —
+                # a held partition, not a single dropped send
+                until = int(spec.params.get("until", 0.0))
+                if until > 0:
+                    fire = int(spec.arg) <= hit <= until
+                else:
+                    fire = hit == int(spec.arg)
             elif spec.mode == "prob":
-                fire = self._rngs[name].random() < spec.arg
+                rng = self._rngs.get(key)
+                if rng is None:
+                    rng = self._rngs[key] = random.Random(
+                        f"{self.seed}:{key}"
+                    )
+                fire = rng.random() < spec.arg
             else:  # after
                 fire = (
                     time.monotonic() - self.activated_at >= spec.arg
@@ -172,11 +233,13 @@ class FaultPlan:
                 skew_s=spec.params.get("skew_ms", 0.0) / 1e3,
                 retry_after_ms=int(spec.params.get("retry_after_ms", 1000)),
                 exit=bool(spec.params.get("exit", 0.0)),
+                after_msgs=int(spec.params.get("after_msgs", 3.0)),
             )
-            self._journal.append(
-                {"point": name, "mode": spec.mode, "hit": hit,
-                 "model": model}
-            )
+            entry = {"point": name, "mode": spec.mode, "hit": hit,
+                     "model": model}
+            if edge is not None:
+                entry["edge"] = f"{edge[0]}->{edge[1]}"
+            self._journal.append(entry)
         self._record(act, model)
         return act
 
@@ -207,14 +270,17 @@ _PLAN: Optional[FaultPlan] = None
 _swap = threading.Lock()  # activate/deactivate only — never on hot paths
 
 
-def point(name: str, model: str = "") -> Optional[FaultAction]:
+def point(name: str, model: str = "",
+          edge: Optional[Tuple[str, str]] = None,
+          surface: str = "") -> Optional[FaultAction]:
     """The hot-path call: None when no schedule is active or the point
     does not fire; a :class:`FaultAction` telling the call site what to
-    inject otherwise."""
+    inject otherwise. ``edge=(src_host, dst_host)`` scopes the per-edge
+    net points; ``surface`` ("rpc"/"http") narrows them further."""
     plan = _PLAN
     if plan is None:
         return None
-    return plan.check(name, model)
+    return plan.check(name, model, edge=edge, surface=surface)
 
 
 def active() -> bool:
@@ -307,11 +373,16 @@ def _parse(spec: str) -> Tuple[Dict[str, _PointSpec], int]:
             )
             continue
         kv: Dict[str, float] = dict(_PARAM_DEFAULTS.get(name, ()))
+        sv: Dict[str, str] = {}
         ok = True
         for p in params:
             k, _, v = p.partition("=")
+            k = k.strip()
+            if k in _STR_PARAMS:
+                sv[k] = v.strip()
+                continue
             try:
-                kv[k.strip()] = float(v)
+                kv[k] = float(v)
             except ValueError:
                 log.warning(
                     "AIOS_TPU_FAULTS: %s: bad param %r ignored — "
@@ -319,7 +390,7 @@ def _parse(spec: str) -> Tuple[Dict[str, _PointSpec], int]:
                 )
                 ok = False
         if ok:
-            schedule[name] = _PointSpec(mode, argv, kv)
+            schedule[name] = _PointSpec(mode, argv, kv, sv)
     return schedule, seed
 
 

@@ -84,7 +84,14 @@ class DisaggService(kvx.KvxService):
 
     def Handoff(self, request, context) -> Iterator[object]:
         from ..proto_gen import fleet_pb2
+        from . import drain
 
+        if drain.draining():
+            # a draining host refuses NEW handoffs immediately — the
+            # source's retry ladder re-hands to a surviving peer
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE, "handoff refused: draining"
+            )
         m = self.manager.get(request.model)
         if m is None or m.pool is None:
             context.abort(
@@ -109,8 +116,11 @@ class DisaggService(kvx.KvxService):
                 n_host = engine.host_store.peek_chain(hashes[n_hbm:])
                 missing = hashes[n_hbm + n_host:]
                 if missing:
+                    from ..faults import net
+
                     for h, entry in kvx.fetch_chain(
-                        request.source_addr, m.name, missing
+                        request.source_addr, m.name, missing,
+                        peer=net.host_of(request.source_addr),
                     ):
                         engine.host_store.put(h, entry)
         req = Request(
@@ -150,6 +160,15 @@ class DisaggService(kvx.KvxService):
             )
         try:
             for tok in handle:
+                if drain.draining():
+                    # drain arrived mid-stream: abort so the SOURCE's
+                    # resume ladder re-hands prompt+emitted to a
+                    # survivor — tokens already relayed are never lost
+                    handle.cancel()
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "draining_host: stream re-handed",
+                    )
                 act = faults.point("fleet.host_kill", m.name)
                 if act is not None:
                     if act.exit:
@@ -192,6 +211,7 @@ class HandoffHandle:
         self._emitted: List[int] = []
         self._attempts = 0
         self._t0 = time.monotonic()
+        self._deadline_s = deadline_s
         self._ttft_at = 0.0
         self._terminal_abort = ""
         self._terminal_retry_ms = 0
@@ -230,6 +250,8 @@ class HandoffHandle:
         fallback when the retry budget or the peer set runs dry."""
         from ..proto_gen import fleet_pb2
 
+        from . import breaker
+
         pool = self._m.pool
         route_ids, _ = pool._route_ids(self._req)
         pairs = None
@@ -238,6 +260,13 @@ class HandoffHandle:
             with self._lock:
                 if self._cancelled:
                     return
+            timeout = self._remaining_deadline()
+            if timeout is not None and timeout <= 0.0:
+                # the client's own gRPC deadline has passed — a gray
+                # decode host must not hold this stream any longer, and
+                # no survivor could deliver tokens the client will see
+                self._terminal("handoff_deadline", 0)
+                return
             target = self._plane.pick_decode(self._m.name, exclude=tried)
             if target is None:
                 break
@@ -250,7 +279,7 @@ class HandoffHandle:
                 # retry pushes the same pages (a survivor that already
                 # received them just overwrites identical entries)
                 pairs = self._m.engine.export_prefix(route_ids)
-            pushed = kvx.push_chain(addr, self._m.name, pairs) > 0
+            pushed = kvx.push_chain(addr, self._m.name, pairs, peer=host) > 0
             hreq = fleet_pb2.HandoffRequest(
                 model=self._m.name,
                 prompt_ids=route_ids,
@@ -279,10 +308,14 @@ class HandoffHandle:
                 self._req.request_id or "<anon>", host, self._attempts,
                 len(self._emitted), pushed,
             )
+            t_call = time.monotonic()
             try:
-                stream = kvx._stub(addr).Handoff(hreq)
+                stream = kvx._stub(addr).Handoff(hreq, timeout=timeout)
                 for chunk in stream:
                     if chunk.done:
+                        breaker.BOARD.record_ok(
+                            host, time.monotonic() - t_call
+                        )
                         if chunk.abort_reason and not self._retryable(
                             chunk.abort_reason
                         ):
@@ -295,8 +328,15 @@ class HandoffHandle:
                         return  # clean completion on the decode host
                     self._emitted.append(chunk.token)
                     yield chunk.token
+                breaker.BOARD.record_ok(host, time.monotonic() - t_call)
                 return  # stream closed without a done-chunk: treat as done
             except (_RemoteDied, grpc.RpcError) as exc:
+                # a _RemoteDied is the DECODE host aborting its own
+                # pool — that is the remote's replica health, not the
+                # network edge, so only transport failures feed the
+                # breaker
+                if not isinstance(exc, _RemoteDied):
+                    breaker.BOARD.record_failure(host, _handoff_cause(exc))
                 with self._lock:
                     if self._cancelled:
                         return
@@ -336,6 +376,15 @@ class HandoffHandle:
             yield tok
         if handle.aborted:
             self._terminal(handle.abort_reason, handle.retry_after_ms)
+
+    def _remaining_deadline(self) -> Optional[float]:
+        """Seconds left of the client's deadline budget, measured from
+        the submit — propagated as the Handoff RPC timeout so a gray
+        decode host can never hold this stream past the point where the
+        client's own gRPC call has already expired."""
+        if self._deadline_s is None:
+            return None
+        return self._deadline_s - (time.monotonic() - self._t0)
 
     def _retryable(self, abort_reason: str) -> bool:
         return (
@@ -389,6 +438,15 @@ class _RemoteDied(Exception):
     chunk — same recovery as a transport-level stream failure."""
 
 
+def _handoff_cause(exc: Exception) -> str:
+    """Map a transport-level handoff failure onto the breaker's
+    cause vocabulary (kvx.KVX_FAIL_CAUSES flavors)."""
+    code = getattr(exc, "code", lambda: None)()
+    if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+        return "timeout"
+    return "unavailable"
+
+
 # -- the plane ---------------------------------------------------------------
 
 class DisaggPlane:
@@ -416,7 +474,11 @@ class DisaggPlane:
         """Choose a decode target: live, not self, transfer-capable,
         role ``decode`` (falling back to ``mixed`` peers when no
         dedicated decode host survives), least heartbeat-reported load
-        first. -> (host, kvx_addr) or None."""
+        first. Quarantined peers (gray hosts — the breaker overlay, NOT
+        membership state) and draining/leaving peers are treated as
+        absent. -> (host, kvx_addr) or None."""
+        from . import breaker
+
         skip = set(exclude or ())
         candidates: List[Tuple[float, str, str]] = []
         fallback: List[Tuple[float, str, str]] = []
@@ -424,6 +486,8 @@ class DisaggPlane:
             if (
                 p.get("self") or p.get("state") != "up"
                 or not p.get("kvx_addr") or p["host"] in skip
+                or (p.get("phase") or "serving") != "serving"
+                or breaker.BOARD.quarantined(p["host"])
             ):
                 continue
             load = 0.0

@@ -96,10 +96,18 @@ def best_peer(peers: List[dict], model: str,
     """``(peer, rows)`` for the peer whose digest promises the deepest
     chain prefix for ``model`` — ``(None, 0)`` when nobody advertises
     overlap. ``peers`` are membership rows (obs/fleet.py ``members()``
-    shape); only live ones with a transfer endpoint compete."""
+    shape); only live, serving, non-quarantined ones with a transfer
+    endpoint compete — a gray host's promised chain is a trap (the
+    fetch would crawl or fail), so the breaker overlay hides it."""
+    from . import breaker
+
     best, best_rows = None, 0
     for p in peers:
         if p.get("state") != "up" or p.get("self") or not p.get("kvx_addr"):
+            continue
+        if (p.get("phase") or "serving") != "serving":
+            continue
+        if breaker.BOARD.quarantined(p.get("host") or ""):
             continue
         rows = score_tails((p.get("gprefix") or {}).get(model) or {}, hashes)
         if rows > best_rows:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -54,8 +55,12 @@ KVX_DIRECTIONS = ("push", "pull")
 #   decode_error  payload failed pack_entry framing
 #   empty         the promised chain came back with zero entries (the
 #                 gossiped digest was stale, or a 64-bit tail collided)
+#   breaker_open  the per-peer circuit breaker (fleet/breaker.py) refused
+#                 the transfer locally — no wire traffic, no timeout
+#                 stall; the peer is quarantined until probes clear it
 KVX_FAIL_CAUSES = (
     "unavailable", "timeout", "crc_mismatch", "decode_error", "empty",
+    "breaker_open",
 )
 
 
@@ -273,30 +278,47 @@ def _rpc_cause(exc) -> str:
 
 
 def push_chain(
-    addr: str, model: str, pairs: Sequence[Tuple[bytes, Dict[str, np.ndarray]]]
+    addr: str, model: str,
+    pairs: Sequence[Tuple[bytes, Dict[str, np.ndarray]]],
+    peer: str = "",
 ) -> int:
     """Push ``(hash, entry)`` pairs (``engine.export_prefix`` output) to
     ``addr``'s host tier. Returns the count the receiver ACCEPTED (its
     crc verification may reject pages ours passed — that is the point of
     verifying at both ends); 0 on any RPC failure, with the cause
     counted. Never raises: a failed push just means the decode host
-    pulls or recomputes."""
+    pulls or recomputes. ``peer`` (the target's fleet host id) gates the
+    transfer on — and feeds — the per-peer circuit breaker: a
+    quarantined peer costs a local ``breaker_open`` count instead of a
+    full transfer-timeout stall."""
     if not pairs:
+        return 0
+    from . import breaker
+
+    if peer and not breaker.BOARD.allow(peer):
+        count_failure(model, "breaker_open")
+        log.debug("kvx push to %s (%s) refused: breaker open", addr, peer)
         return 0
     triples = [
         (h, paged.HostPageStore._entry_crc(e), paged.pack_entry(e))
         for h, e in pairs
     ]
     sent_bytes = sum(len(p) for _, _, p in triples)
+    t0 = time.monotonic()
     try:
         ack = _stub(addr).Push(
             entries_to_chunks(model, triples), timeout=transfer_timeout()
         )
     except Exception as exc:  # noqa: BLE001 - any transport failure is the
         # same outcome: the pages do not arrive; the counter carries why
-        count_failure(model, _rpc_cause(exc))
+        cause = _rpc_cause(exc)
+        count_failure(model, cause)
+        if peer:
+            breaker.BOARD.record_failure(peer, cause)
         log.warning("kvx push to %s failed: %r", addr, exc)
         return 0
+    if peer:
+        breaker.BOARD.record_ok(peer, time.monotonic() - t0)
     obs.FLEET_KVX_PAGES.labels(model=model, direction="push").inc(
         float(ack.accepted)
     )
@@ -308,19 +330,29 @@ def push_chain(
 
 def fetch_chain(
     addr: str, model: str, hashes: Sequence[bytes],
-    budget_bytes: int = 0,
+    budget_bytes: int = 0, peer: str = "",
 ) -> List[Tuple[bytes, Dict[str, np.ndarray]]]:
     """Pull a promised chain from ``addr``. Every received entry is
     verified HERE (receiving end); the chain truncates at the first bad
     or out-of-order entry — a prefix chain with a hole restores nothing
     past it. Returns verified ``(hash, entry)`` pairs, possibly empty
-    (the caller falls back to local prefill); never raises."""
+    (the caller falls back to local prefill); never raises. ``peer``
+    (the source's fleet host id) gates on — and feeds — the per-peer
+    circuit breaker, same contract as :func:`push_chain`."""
     from ..proto_gen import fleet_pb2
+    from . import breaker
 
+    if peer and not breaker.BOARD.allow(peer):
+        count_failure(model, "breaker_open")
+        log.debug("kvx fetch from %s (%s) refused: breaker open",
+                  addr, peer)
+        return []
     want = list(hashes)
     out: List[Tuple[bytes, Dict[str, np.ndarray]]] = []
     got_bytes = 0
     counted = False
+    fail_cause = ""
+    t0 = time.monotonic()
     try:
         stream = _stub(addr).Fetch(
             fleet_pb2.FetchRequest(
@@ -342,10 +374,12 @@ def fetch_chain(
                 except CrcMismatch:
                     count_failure(model, "crc_mismatch")
                     counted = True
+                    fail_cause = "crc_mismatch"
                     raise _Truncate()
                 except ValueError:
                     count_failure(model, "decode_error")
                     counted = True
+                    fail_cause = "decode_error"
                     raise _Truncate()
                 out.append((e.hash, entry))
                 got_bytes += len(e.payload)
@@ -353,9 +387,17 @@ def fetch_chain(
         pass
     except Exception as exc:  # noqa: BLE001 - transport failure mid-pull:
         # keep the verified prefix, count why the rest never came
-        count_failure(model, _rpc_cause(exc))
+        fail_cause = _rpc_cause(exc)
+        count_failure(model, fail_cause)
         counted = True
         log.warning("kvx fetch from %s failed: %r", addr, exc)
+    if peer:
+        if fail_cause:
+            breaker.BOARD.record_failure(peer, fail_cause)
+        else:
+            # an "empty" chain from a healthy peer is a stale digest,
+            # not a peer fault — it does not feed the breaker
+            breaker.BOARD.record_ok(peer, time.monotonic() - t0)
     if not out:
         # a promise that yielded nothing is its own cause — unless a
         # more specific failure already explained it

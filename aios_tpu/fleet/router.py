@@ -185,7 +185,8 @@ class FleetRouter:
         if reason == "remote_pull":
             store = m.engine.host_store
             got = kvx.fetch_chain(
-                detail["addr"], m.name, detail["hashes"]
+                detail["addr"], m.name, detail["hashes"],
+                peer=detail["peer"],
             ) if store is not None else []
             if not got:
                 reason = "fallback_local"  # transfer failed; kvx counted why

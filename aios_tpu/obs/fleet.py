@@ -42,6 +42,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import socket
 import threading
 import time
@@ -274,18 +275,31 @@ def _self_capacity() -> dict:
 
 def _http_json(url: str, payload: Optional[dict] = None,
                timeout: float = 2.0) -> dict:
+    # the fleet's HTTP injection choke point (faults/net.py): announce,
+    # stitch, and drain traffic all pass here — check_send models the
+    # outbound edge, check_drop_response the severed reply
+    from ..faults import net
+
+    net.check_send(url, "http")
     data = json.dumps(payload).encode("utf-8") if payload is not None else None
     req = urllib.request.Request(
         url, data=data,
         headers={"Content-Type": "application/json"} if data else {},
     )
     with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.loads(r.read(_MAX_BODY_BYTES).decode("utf-8"))
+        body = json.loads(r.read(_MAX_BODY_BYTES).decode("utf-8"))
+    net.check_drop_response(url, "http")
+    return body
 
 
 def _http_text(url: str, timeout: float = 2.0) -> str:
+    from ..faults import net
+
+    net.check_send(url, "http")
     with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read(_MAX_BODY_BYTES).decode("utf-8")
+        body = r.read(_MAX_BODY_BYTES).decode("utf-8")
+    net.check_drop_response(url, "http")
+    return body
 
 
 # -- exposition relabeling ---------------------------------------------------
@@ -461,6 +475,8 @@ class FleetRegistry:
         with self._lock:
             self._seq += 1
             seq = self._seq
+        from ..fleet import drain
+
         desc = {
             **self.identity,
             "metrics_addr": self.metrics_addr,
@@ -471,6 +487,9 @@ class FleetRegistry:
             "gprefix": _self_gprefix(),
             "capacity": _self_capacity(),
             "slo": _self_slo(),
+            # the drain ladder phase (fleet/drain.py DRAIN_PHASES):
+            # peers stop routing to a non-"serving" host before it dies
+            "phase": drain.phase(),
         }
         # Every freshly built descriptor also refreshes OUR stored
         # member row. Before this, self's desc was folded in only at
@@ -511,6 +530,12 @@ class FleetRegistry:
         addr = desc.get("metrics_addr")
         if addr and addr != self.metrics_addr:
             self._add_peer(addr)
+        # feed the fault layer's edge namer: every descriptor teaches it
+        # which fleet host owns which address (outside the fleet lock)
+        from ..faults import net
+
+        for k in ("metrics_addr", "kvx_addr"):
+            net.map_addr(desc.get(k) or "", key[0])
         return edges
 
     def receive(self, desc: dict) -> dict:
@@ -581,9 +606,18 @@ class FleetRegistry:
                      frm or "new", to)
 
     def _add_peer(self, addr: str) -> None:
+        added = False
         with self._lock:
             if addr not in self._peer_addrs and addr != self.metrics_addr:
                 self._peer_addrs.append(addr)
+                added = True
+        if added:
+            # pre-register the announce-failure child so the family
+            # renders 0 for a healthy peer (absence-vs-zero discipline);
+            # OUTSIDE the fleet lock — registration takes registry locks
+            from . import instruments
+
+            instruments.FLEET_ANNOUNCE_FAILURES.labels(peer=addr)
 
     # -- surfaces -------------------------------------------------------------
 
@@ -602,11 +636,20 @@ class FleetRegistry:
                         k: m.get("desc", {}).get(k)
                         for k in ("rank", "version", "metrics_addr",
                                   "kvx_addr", "pid", "seq", "pools",
-                                  "gprefix", "capacity", "slo")
+                                  "gprefix", "capacity", "slo", "phase")
                     },
                 }
                 for key, m in sorted(self._members.items())
             ]
+        # the quarantine overlay (fleet/breaker.py) — computed OUTSIDE
+        # the fleet lock (no fleet->quarantine lock edge); orthogonal to
+        # "state": a host can be "up" by heartbeat and still gray
+        from ..fleet import breaker
+
+        for r in rows:
+            r["quarantined"] = (
+                not r["self"] and breaker.BOARD.quarantined(r["host"])
+            )
         return rows
 
     def journal(self) -> List[dict]:
@@ -658,17 +701,27 @@ class FleetRegistry:
         peer's /metrics, host label injected. A failing scrape drops
         the host from this response and counts on
         aios_tpu_fleet_scrape_failures_total — absence IS the signal."""
+        from ..fleet import breaker
         from . import instruments
 
         sources = [(self.identity["host"], self.registry.render())]
         for host, role, addr in self._scrape_targets():
+            # scrapes double as the quarantine's half-open probes: an
+            # open breaker skips the scrape (absence IS the signal), a
+            # half-open one spends a probe slot on the real fetch — an
+            # idle fleet heals through its own federation loop
+            if not breaker.BOARD.allow(host):
+                continue
+            t0 = self.clock()
             try:
                 sources.append((host, _http_text(
                     f"http://{addr}/metrics",
                     timeout=self.cfg.scrape_timeout,
                 )))
+                breaker.BOARD.record_ok(host, self.clock() - t0)
             except Exception as exc:  # noqa: BLE001 - a dead scrape is
                 # evidence, not an error; the counter records it
+                breaker.BOARD.record_failure(host, "unavailable")
                 instruments.FLEET_SCRAPE_FAILURES.labels(
                     host=host, role=role
                 ).inc()
@@ -692,17 +745,24 @@ class FleetRegistry:
         ]
         if local:
             host_tls[self.identity["host"]] = local[:limit]
+        from ..fleet import breaker
+
         for host, role, addr in self._scrape_targets():
+            if not breaker.BOARD.allow(host):
+                continue
+            t0 = self.clock()
             try:
                 got = _http_json(
                     f"http://{addr}/debug/requests?trace={trace_id}"
                     f"&limit={limit}",
                     timeout=self.cfg.scrape_timeout,
                 )
+                breaker.BOARD.record_ok(host, self.clock() - t0)
             except Exception as exc:  # noqa: BLE001 - a peer missing from
                 # the stitch is visible as a missing lane; count it
                 from . import instruments
 
+                breaker.BOARD.record_failure(host, "unavailable")
                 instruments.FLEET_SCRAPE_FAILURES.labels(
                     host=host, role=role
                 ).inc()
@@ -722,6 +782,8 @@ class FleetRegistry:
         desc = self.self_descriptor()
         with self._lock:
             targets = list(self._peer_addrs)
+        from . import instruments
+
         for addr in targets:
             try:
                 reply = _http_json(
@@ -729,7 +791,9 @@ class FleetRegistry:
                     timeout=self.cfg.scrape_timeout,
                 )
             except Exception as exc:  # noqa: BLE001 - unreachable peers
-                # age out through the state machine; debug-log the why
+                # age out through the state machine; the counter makes a
+                # silently-failing edge visible BEFORE suspect/dead does
+                instruments.FLEET_ANNOUNCE_FAILURES.labels(peer=addr).inc()
                 log.debug("fleet announce to %s failed: %r", addr, exc)
                 continue
             member = reply.get("member")
@@ -749,13 +813,20 @@ class FleetRegistry:
         self._thread.start()
 
     def _loop(self) -> None:
+        # seeded per-host jitter: N workers booted by one supervisor
+        # would otherwise announce in lockstep forever, synchronizing
+        # their scrape bursts; +/-25% desynchronizes them while staying
+        # deterministic per host (no global-RNG draw on the hot loop)
+        rng = random.Random(f"announce:{self.identity['host']}")
         while not self._stop.is_set():
             try:
                 self.announce_once()
             except Exception:  # noqa: BLE001 - the heartbeat must outlive
                 # any single bad round; the log carries the evidence
                 log.exception("fleet heartbeat round failed")
-            self._stop.wait(self.cfg.interval_secs)
+            self._stop.wait(
+                self.cfg.interval_secs * (0.75 + 0.5 * rng.random())
+            )
 
     def stop(self) -> None:
         self._stop.set()

@@ -82,6 +82,13 @@ EVENT_KINDS = (
     # in-flight stream to a peer host (aios_tpu/fleet/disagg.py) — on
     # the request timeline when it rides one, else the model lane
     "handoff",
+    # "quarantine": a per-peer circuit-breaker state edge (closed/open/
+    # half_open) on the "fleet" pseudo-model lane
+    # (aios_tpu/fleet/breaker.py) — the gray-host evidence trail
+    "quarantine",
+    # "drain": a graceful-drain phase edge (serving -> draining ->
+    # leaving) on the "fleet" pseudo-model lane (aios_tpu/fleet/drain.py)
+    "drain",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these
@@ -89,7 +96,12 @@ EVENT_KINDS = (
 # "degraded" is the autoscaler's ladder rung 3: best-effort (priority <
 # the protected floor) requests shed while the pool digs out of an SLO
 # burn — the reactive/operational tiers keep admitting.
-SHED_CAUSES = ("quota", "deadline", "queue_full", "draining", "degraded")
+#
+# "draining_host" is the fleet drain protocol (aios_tpu/fleet/drain.py):
+# the whole HOST is leaving, so unlike the per-pool "draining" cause the
+# retry hint points clients at the surviving fleet, not this process.
+SHED_CAUSES = ("quota", "deadline", "queue_full", "draining", "degraded",
+               "draining_host")
 
 # Abort causes: the batcher's human-readable ``abort_reason`` strings
 # normalize onto this enum (the free-form text rides in the timeline's

@@ -23,6 +23,9 @@ Routes:
     journal (JSON; what fleetctl renders);
   * ``/fleet/announce`` — POST: one member's heartbeat descriptor in,
     ours + known peers back (the membership gossip hop);
+  * ``/fleet/drain`` — POST: start this host's graceful drain
+    (fleet/drain.py; 202 + current phase, ``?timeout=S`` bounds the
+    in-flight wait; ``fleetctl drain`` drives it);
   * ``/debug/requests``  — recent flight-recorder timelines (JSON;
     ``?model=&limit=&events=0&trace=<id>``);
   * ``/debug/trace``     — the same timelines as Chrome trace-event /
@@ -312,6 +315,34 @@ def start_metrics_server(
             from . import fleet
 
             parsed = urlparse(self.path)
+            if parsed.path == "/fleet/drain":
+                # graceful drain trigger (fleet/drain.py; fleetctl drain
+                # drives it): 202 — the protocol runs on a worker thread
+                from ..fleet import drain
+
+                if drain.COORD is None:
+                    self.send_error(
+                        404, "drain coordinator not armed on this host"
+                    )
+                    return
+                q = parse_qs(parsed.query)
+                try:
+                    timeout_s = float(q["timeout"][0]) if "timeout" in q \
+                        else None
+                except ValueError:
+                    timeout_s = None
+                phase = drain.request_drain(timeout_s)
+                body = json.dumps({
+                    "phase": phase,
+                    "host": fleet.FLEET.identity["host"]
+                    if fleet.FLEET is not None else "",
+                }).encode("utf-8")
+                self.send_response(202)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if parsed.path != "/fleet/announce":
                 self.send_error(404)
                 return
@@ -324,7 +355,22 @@ def start_metrics_server(
                 desc = json.loads(self.rfile.read(n).decode("utf-8"))
                 if not isinstance(desc, dict):
                     raise ValueError("announce body must be an object")
-                body = json.dumps(fleet.FLEET.receive(desc)).encode("utf-8")
+                # the server side of a seeded per-edge partition
+                # (faults/net.py): the REPLY travels the self->announcer
+                # edge — a fired one-way partition still folds the
+                # peer's descriptor (their bytes reached us) but
+                # withholds the reply; a full partition refuses both
+                from ..faults import net
+
+                fold, reply = net.gate_announce(str(desc.get("host", "")))
+                if not fold:
+                    self.send_error(503, "announce refused: partitioned")
+                    return
+                reply_body = fleet.FLEET.receive(desc)
+                if not reply:
+                    self.send_error(503, "announce reply withheld")
+                    return
+                body = json.dumps(reply_body).encode("utf-8")
                 status = 200
             except Exception as exc:  # noqa: BLE001 - a malformed
                 # announce must not take down the exposition endpoint

@@ -490,6 +490,22 @@ FLEET_ROUTE = Counter(
     "outcomes)",
     ("model", "reason"),
 )
+FLEET_PEER_BREAKER = Gauge(
+    "aios_tpu_fleet_peer_breaker_state_total",
+    "Per-peer circuit-breaker state as an index into the closed "
+    "breaker.BREAKER_STATES enum (0=closed, 1=open, 2=half_open; "
+    "anything non-zero means the peer is quarantined — routed around "
+    "until consecutive successful probes clear it). host is the "
+    "OBSERVING side of the edge",
+    ("host", "peer"),
+)
+FLEET_ANNOUNCE_FAILURES = Counter(
+    "aios_tpu_fleet_announce_failures_total",
+    "Heartbeat announces that never got a reply, per peer address — "
+    "a climbing single-peer count with members still up is the "
+    "asymmetric-partition signature (RUNBOOK §11)",
+    ("peer",),
+)
 
 # -- process identity (obs/fleet.py stamp, every metrics endpoint) ---------
 

@@ -25,6 +25,7 @@ import grpc
 
 from .. import rpc
 from ..fleet import disagg as fleet_disagg
+from ..fleet import drain as fleet_drain
 from ..fleet import gprefix as fleet_gprefix
 from ..obs import fleet, flightrec, instruments as obs, slo, tracing
 from ..obs.http import maybe_start_metrics_server
@@ -488,6 +489,9 @@ def serve(
     # role): a solo host keeps the exact pre-fleet submit path
     if fleet.FleetConfig().active() or os.environ.get("AIOS_TPU_FLEET_ROLE"):
         fleet_disagg.arm(service.manager)
+        # the graceful-drain coordinator (POST /fleet/drain) arms with
+        # the data plane: a solo host has no fleet to drain toward
+        fleet_drain.arm(service.manager)
     service.metrics_server, service.metrics_port = maybe_start_metrics_server(
         "runtime",
         metrics_port,

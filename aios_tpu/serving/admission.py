@@ -42,6 +42,24 @@ MAX_RETRY_AFTER_MS = 30_000
 # per-process tenant-bucket cap: tenant names derive from client input
 _MAX_TENANTS = 4096
 
+# Host-level drain flag (fleet/drain.py flips it): module-level so EVERY
+# pool in the process sheds new work while the host drains — unlike the
+# per-pool "draining" cause, "draining_host" tells clients the whole
+# host is leaving and they should resubmit to a surviving fleet peer.
+# Plain bool store/load, no lock (same cross-thread pattern as
+# min_priority below).
+_host_draining = False
+
+
+def set_host_draining(active: bool) -> None:
+    """Flip the process-wide drain gate (fleet/drain.py owns this)."""
+    global _host_draining
+    _host_draining = bool(active)
+
+
+def host_draining() -> bool:
+    return _host_draining
+
 
 class AdmissionError(Exception):
     """A request shed at the front door. ``cause`` is one of
@@ -148,6 +166,19 @@ class AdmissionController:
         """Count and build (not raise) the shed error for ``cause``."""
         self._obs_shed[cause].inc()
         return AdmissionError(message, cause, retry_after_ms, retriable)
+
+    # -- host drain gate (before every other gate: a leaving host must
+    # not debit quota or queue work it will never finish) ------------------
+
+    def check_host_drain(self) -> None:
+        if not _host_draining:
+            return
+        raise self.shed(
+            "draining_host",
+            "host is draining (graceful drain in progress): resubmit to "
+            "a surviving fleet peer",
+            2000,
+        )
 
     # -- gate 0: degrade-ladder priority floor (clock-free, runs first) ----
 

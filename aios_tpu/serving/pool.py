@@ -354,6 +354,9 @@ class ReplicaPool:
             raise self.admission.shed(
                 "draining", f"model {self.name} is draining", 2000
             )
+        # host-level graceful drain (fleet/drain.py): the whole host is
+        # leaving — shed before any gate debits quota or queues work
+        self.admission.check_host_drain()
         # degrade ladder rung 3 (clock-free policy gate, before any
         # routing work): best-effort tiers shed while the autoscaler digs
         # the pool out of an SLO burn; priority >= 1 stays protected

@@ -6,6 +6,8 @@ Usage:
     scripts/fleetctl.py status      [--target HOST:PORT] [--json]
     scripts/fleetctl.py top         [--target HOST:PORT] [--json]
     scripts/fleetctl.py drain-check [--target HOST:PORT] --host HOSTID
+    scripts/fleetctl.py drain       [--target HOST:PORT] --host HOSTID
+                                    [--timeout S] [--json]
 
 Target is any ONE member's metrics endpoint (``--target``, else
 ``AIOS_TPU_FLEET_TARGET``, default 127.0.0.1:9100) — membership is
@@ -26,6 +28,12 @@ symmetric, so any member renders the whole fleet.
                       batch occupancy (idle), 1 when it still holds
                       work, 2 when the host is unknown or the target is
                       unreachable.
+  * ``drain``       — ACTUALLY drain ``--host``: POST its
+                      ``/fleet/drain`` (resolved from the membership
+                      table), then poll the table until the host
+                      announces the terminal ``leaving`` phase. Exit 0
+                      drained, 1 still holding at ``--timeout``, 2 when
+                      the host is unknown/unreachable.
 
 Human-readable tables go to stderr; ONE machine-readable JSON verdict
 line goes to stdout (the benchdiff.py convention), so scripts can parse
@@ -211,21 +219,92 @@ def cmd_drain_check(data: dict, host: str) -> int:
     return 0 if not holding else 1
 
 
+def cmd_drain(target: str, host: str, timeout: float,
+              as_json: bool = False) -> int:
+    """Drive one host's graceful drain end to end: resolve its metrics
+    endpoint off the membership table, POST /fleet/drain, then poll any
+    member's table until the host's descriptor announces "leaving" (the
+    descriptor outlives the process — membership keeps the last fold)."""
+    import time
+
+    try:
+        data = fetch_members(target)
+    except Exception as exc:  # noqa: BLE001 - see main()'s fetch
+        log(f"drain: cannot reach {target}: {exc!r}")
+        print(json.dumps({"cmd": "drain", "host": host,
+                          "error": repr(exc)[:200]}, sort_keys=True))
+        return 2
+    rows = [m for m in data.get("members", []) if m["host"] == host]
+    addrs = [m.get("metrics_addr") for m in rows if m.get("metrics_addr")]
+    if not addrs:
+        log(f"drain: host {host!r} not in the membership table (or it "
+            "never announced a metrics endpoint)")
+        print(json.dumps({"cmd": "drain", "host": host,
+                          "error": "unknown host"}, sort_keys=True))
+        return 2
+    url = f"http://{addrs[0]}/fleet/drain?timeout={max(timeout, 0.1):g}"
+    try:
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            started = json.loads(r.read().decode("utf-8"))
+    except Exception as exc:  # noqa: BLE001 - a dead drain endpoint is
+        # the verdict, not a traceback
+        log(f"drain: POST {url} failed: {exc!r}")
+        print(json.dumps({"cmd": "drain", "host": host,
+                          "error": repr(exc)[:200]}, sort_keys=True))
+        return 2
+    log(f"drain: {host} acknowledged (phase={started.get('phase')}); "
+        "polling for leaving ...")
+    deadline = time.monotonic() + max(timeout, 0.1)
+    phase = str(started.get("phase") or "")
+    while time.monotonic() < deadline and phase != "leaving":
+        time.sleep(0.2)
+        try:
+            data = fetch_members(target, timeout=2.0)
+        except Exception:  # noqa: BLE001 - the polled member may be the
+            # draining one; keep polling until the deadline decides
+            continue
+        for m in data.get("members", []):
+            if m["host"] == host and m.get("phase"):
+                phase = str(m["phase"])
+    drained = phase == "leaving"
+    verdict = {"cmd": "drain", "host": host, "phase": phase,
+               "pass": drained}
+    if as_json:
+        verdict["members"] = [
+            {k: m.get(k) for k in ("host", "role", "state", "phase",
+                                   "quarantined")}
+            for m in data.get("members", [])
+        ]
+    log(f"drain: {host} -> {phase or 'unknown'} "
+        f"({'drained' if drained else 'still holding at timeout'})")
+    print(json.dumps(verdict, sort_keys=True))
+    return 0 if drained else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="fleetctl", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("cmd", choices=["status", "top", "drain-check"])
+    ap.add_argument("cmd", choices=["status", "top", "drain-check",
+                                    "drain"])
     ap.add_argument("--target", default=default_target(),
                     help="any member's metrics endpoint (host:port)")
     ap.add_argument("--host", default="",
-                    help="host id to drain-check")
-    ap.add_argument("--timeout", type=float, default=5.0)
+                    help="host id to drain-check / drain")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="fetch timeout; for drain, also the bound on "
+                         "waiting for the leaving phase")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="status/top: full row set as one JSON document "
                          "on stdout instead of the table + verdict")
     args = ap.parse_args(argv)
+    if args.cmd == "drain":
+        if not args.host:
+            ap.error("drain requires --host")
+        return cmd_drain(args.target, args.host, args.timeout,
+                         as_json=args.as_json)
     try:
         data = fetch_members(args.target, timeout=args.timeout)
     except Exception as exc:  # noqa: BLE001 - unreachable target is the

@@ -46,7 +46,22 @@
 #                                  run, and the survivor gossips the
 #                                  restored prefix digest; run twice,
 #                                  verdicts identical (aios_tpu/fleet/,
-#                                  docs/SERVING.md, docs/RUNBOOK.md §10).
+#                                  docs/SERVING.md, docs/RUNBOOK.md §10);
+#   8. the partition smoke        — scripts/partition_smoke.py: three
+#                                  processes under seeded PER-EDGE
+#                                  network faults — an asymmetric
+#                                  partition walks one host to dead and
+#                                  back while the reverse edge stays
+#                                  clean, a handoff severs mid-stream
+#                                  into quarantine + resume, federation
+#                                  probes heal the breaker, and a
+#                                  graceful drain re-hands a live stream
+#                                  and exits 0 — token-identical to solo,
+#                                  run twice, verdicts identical
+#                                  (aios_tpu/faults/net.py,
+#                                  aios_tpu/fleet/breaker.py,
+#                                  aios_tpu/fleet/drain.py,
+#                                  docs/FAULTS.md, docs/RUNBOOK.md §11).
 #
 # The devprof threshold here is looser than benchdiff's default: the
 # committed baseline was captured on a different run of a noisy shared-
@@ -64,31 +79,35 @@ threshold="${PREFLIGHT_DEVPROF_THRESHOLD:-0.75}"
 workdir="$(mktemp -d)"
 trap 'rm -rf "$workdir"' EXIT
 
-echo "[preflight 1/7] static analysis (scripts/analyze.sh)" >&2
+echo "[preflight 1/8] static analysis (scripts/analyze.sh)" >&2
 scripts/analyze.sh
 
-echo "[preflight 2/7] obs-lint subset (tests/test_obs_lint.py)" >&2
+echo "[preflight 2/8] obs-lint subset (tests/test_obs_lint.py)" >&2
 python -m pytest tests/test_obs_lint.py -q -p no:cacheprovider
 
-echo "[preflight 3/7] seeded chaos storm (bench.py --chaos)" >&2
+echo "[preflight 3/8] seeded chaos storm (bench.py --chaos)" >&2
 python bench.py --chaos > "$workdir/chaos.json"
 
-echo "[preflight 4/7] devprof sentinel (bench.py --devprof vs" \
+echo "[preflight 4/8] devprof sentinel (bench.py --devprof vs" \
      "BASELINE_DEVPROF.json, threshold +${threshold})" >&2
 python bench.py --devprof > "$workdir/devprof.json"
 python scripts/benchdiff.py BASELINE_DEVPROF.json \
     "$workdir/devprof.json" --threshold "$threshold"
 
-echo "[preflight 5/7] storm smoke (bench.py --storm --smoke," \
+echo "[preflight 5/8] storm smoke (bench.py --storm --smoke," \
      "seeded, run twice, deterministic verdict)" >&2
 python bench.py --storm --smoke > "$workdir/storm.json"
 
-echo "[preflight 6/7] fleet smoke (scripts/fleet_smoke.py: two" \
+echo "[preflight 6/8] fleet smoke (scripts/fleet_smoke.py: two" \
      "processes federate + stitch, one dies, journals identical)" >&2
 python scripts/fleet_smoke.py > "$workdir/fleet.json"
 
-echo "[preflight 7/7] disagg smoke (scripts/disagg_smoke.py: prefill" \
+echo "[preflight 7/8] disagg smoke (scripts/disagg_smoke.py: prefill" \
      "+ 2 decode processes, kill + resume, token-identical twice)" >&2
 python scripts/disagg_smoke.py > "$workdir/disagg.json"
+
+echo "[preflight 8/8] partition smoke (scripts/partition_smoke.py:" \
+     "per-edge faults, quarantine, graceful drain, identical twice)" >&2
+python scripts/partition_smoke.py > "$workdir/partition.json"
 
 echo "[preflight] PASS" >&2

@@ -1,0 +1,362 @@
+"""Fleet fault domains (ISSUE 18): per-edge network faults, the
+gray-host quarantine breaker, and the graceful-drain protocol.
+
+Fast CPU tier only — every test runs on injected clocks and in-process
+fakes. The slow acceptance (two real workers partitioned, severed,
+quarantined, drained) lives in scripts/partition_smoke.py (preflight
+gate 8), not here.
+"""
+
+import pytest
+
+from aios_tpu import faults
+from aios_tpu.faults import net
+from aios_tpu.fleet import breaker as breaker_mod
+from aios_tpu.fleet.breaker import BreakerBoard, BreakerConfig
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    """Every test runs as fleet host "hostA" with no schedule armed, a
+    fresh addr map, and the default process-wide board restored — a
+    leaked plan or edge map would inject faults into unrelated tests."""
+    monkeypatch.setenv("AIOS_TPU_FLEET_HOST", "hostA")
+    faults.deactivate()
+    net._reset()
+    yield
+    faults.deactivate()
+    net._reset()
+    breaker_mod.reset()
+
+
+def _cfg(**over):
+    cfg = BreakerConfig()
+    cfg.threshold = over.get("threshold", 2.0)
+    cfg.cooldown_secs = over.get("cooldown_secs", 5.0)
+    cfg.max_cooldown_secs = over.get("max_cooldown_secs", 60.0)
+    cfg.probes = over.get("probes", 2)
+    cfg.lat_floor_secs = over.get("lat_floor_secs", 0.0)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# per-edge determinism (faults/net.py over faults/inject.py)
+# ---------------------------------------------------------------------------
+
+
+def test_edge_hit_counters_are_independent():
+    """Hits count PER (src, dst) edge: traffic to one peer never shifts
+    another edge's hit index — the determinism anchor of the per-edge
+    contract."""
+    faults.activate("net.partition=nth:2,dst=hostB")
+    net.check_send("hostB", "rpc")            # hostB hit 1: clean
+    net.check_send("hostC", "rpc")            # other edge, other counter
+    net.check_send("hostC", "rpc")
+    with pytest.raises(net.NetFaultRefused) as err:
+        net.check_send("hostB", "rpc")        # hostB hit 2: fires
+    assert err.value.edge == ("hostA", "hostB")
+    assert err.value.hit == 2
+    net.check_send("hostC", "rpc")            # hostC still never fires
+
+
+def test_until_widens_nth_to_a_held_window():
+    """``until=M`` holds the fault from hit N through hit M — the
+    sustained-partition grammar the membership arc needs."""
+    faults.activate("net.partition=nth:2,until=4,dst=hostB")
+    net.check_send("hostB", "rpc")            # hit 1: before the window
+    for _ in range(3):                        # hits 2..4: held
+        with pytest.raises(net.NetFaultRefused):
+            net.check_send("hostB", "rpc")
+    net.check_send("hostB", "rpc")            # hit 5: healed
+
+
+def test_surface_mismatch_neither_fires_nor_consumes():
+    """A spec scoped surface=rpc must ignore http traffic WITHOUT
+    consuming a hit — otherwise unrelated-surface traffic would shift
+    the k-th-send determinism the schedule anchors on."""
+    faults.activate("net.drop_after=nth:1,dst=hostB,surface=rpc,"
+                    "after_msgs=2")
+    for _ in range(3):
+        net.check_drop_response("hostB", "http")  # wrong surface: no-op
+    severed = net.sever_stream("hostB", iter(range(10)))  # rpc hit 1
+    assert next(severed) == 0
+    assert next(severed) == 1                 # after_msgs=2 delivered
+    with pytest.raises(net.NetFaultSevered):
+        next(severed)
+
+
+def test_delay_point_sleeps_instead_of_raising():
+    faults.activate("net.delay=prob:1.0,delay_ms=1,dst=hostB")
+    net.check_send("hostB", "rpc")            # delayed, not refused
+
+
+def test_netfault_doubles_as_unavailable_rpc_error():
+    """Every existing ``except grpc.RpcError`` recovery path must catch
+    an injected edge fault exactly as it catches a real dead peer."""
+    import grpc
+
+    err = net.NetFaultRefused("net.partition", ("hostA", "hostB"), 1)
+    assert isinstance(err, ConnectionError)
+    assert isinstance(err, grpc.RpcError)
+    assert err.code() == grpc.StatusCode.UNAVAILABLE
+    assert "hostA->hostB" in err.details()
+
+
+def test_addr_to_host_mapping_survives_urls():
+    """Edges are named by fleet host ids: gossip teaches the namer each
+    peer's addresses; an unseen address resolves to itself."""
+    net.map_addr("10.0.0.7:9100", "hostB")
+    assert net.host_of("10.0.0.7:9100") == "hostB"
+    assert net.host_of("http://10.0.0.7:9100/metrics") == "hostB"
+    assert net.host_of("127.0.0.1:1234") == "127.0.0.1:1234"
+
+
+def test_solo_invariance_without_a_schedule():
+    """Faults off (the solo serving path): every net gate is a strict
+    no-op — same iterator object back, announces unconditionally
+    accepted, no points scheduled."""
+    net.check_send("hostB", "rpc")
+    net.check_drop_response("hostB")
+    stream = iter(range(3))
+    assert net.sever_stream("hostB", stream) is stream
+    assert net.gate_announce("hostB") == (True, True)
+    assert net.active_points() == ()
+
+
+# ---------------------------------------------------------------------------
+# asymmetric partition (gate_announce + the membership state machine)
+# ---------------------------------------------------------------------------
+
+
+def test_gate_announce_models_both_partition_flavors():
+    """The announce REPLY travels the self->announcer edge: a one-way
+    partition folds the peer's descriptor (their data reached us) but
+    withholds the reply; a full partition refuses both directions."""
+    faults.activate("net.partition_oneway=nth:1,until=100,dst=hostB,"
+                    "surface=http")
+    assert net.gate_announce("hostB") == (True, False)
+    assert net.gate_announce("hostC") == (True, True)
+    faults.activate("net.partition=nth:1,until=100,dst=hostB,"
+                    "surface=http")
+    assert net.gate_announce("hostB") == (False, False)
+
+
+def test_asymmetric_partition_membership_divergence():
+    """The up/suspect/dead machine under asymmetry: A keeps hearing B
+    (B stays up on A) while B hears nothing from A — so B walks A
+    through suspect to dead. Divergent views are correct here; the
+    gossip reply, once the edge heals, reconverges them."""
+    from aios_tpu.obs.fleet import FleetConfig, FleetRegistry
+
+    def _registry(self_host, now):
+        cfg = FleetConfig()
+        cfg.suspect_secs = 5.0
+        cfg.dead_secs = 10.0
+        cfg.peers = ()
+        return FleetRegistry(
+            {"host": self_host, "role": "runtime", "rank": "0",
+             "version": "t"},
+            "127.0.0.1:9100", cfg=cfg, clock=lambda: now[0],
+        )
+
+    now = [100.0]
+    reg_a = _registry("hostA", now)
+    reg_b = _registry("hostB", now)
+    desc_b = {"host": "hostB", "role": "runtime", "rank": "1",
+              "version": "t", "metrics_addr": "127.0.0.1:9101"}
+    desc_a = {"host": "hostA", "role": "runtime", "rank": "0",
+              "version": "t", "metrics_addr": "127.0.0.1:9100"}
+    reg_a.receive(desc_b)
+    reg_b.receive(desc_a)
+    # the partition: B's announces still reach A; A's never reach B
+    for t in (103.0, 106.0, 109.0, 112.0):
+        now[0] = t
+        reg_a.receive(desc_b)
+        reg_a.tick(now=t)
+        reg_b.tick(now=t)
+    a_view = {m["host"]: m["state"] for m in reg_a.members()}
+    b_view = {m["host"]: m["state"] for m in reg_b.members()}
+    assert a_view["hostB"] == "up"
+    assert b_view["hostA"] == "dead"
+
+
+# ---------------------------------------------------------------------------
+# the gray-host quarantine breaker (fleet/breaker.py, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_cools_down_and_probes_closed():
+    now = [0.0]
+    b = BreakerBoard(cfg=_cfg(), clock=lambda: now[0])
+    assert b.allow("hostB")
+    b.record_failure("hostB", "unavailable")
+    assert b.state("hostB") == "closed"       # score 1 < threshold 2
+    b.record_failure("hostB", "timeout")
+    assert b.state("hostB") == "open"
+    assert b.quarantined("hostB")
+    assert not b.allow("hostB")               # cooldown not elapsed
+    now[0] = 5.1
+    assert b.allow("hostB")                   # half-open, probe 1 of 2
+    assert b.state("hostB") == "half_open"
+    assert b.quarantined("hostB")             # overlay until CLOSED
+    b.record_ok("hostB")
+    b.record_ok("hostB")                      # 2 consecutive: closed
+    assert b.state("hostB") == "closed"
+    assert not b.quarantined("hostB")
+    assert b.snapshot()["hostB"]["score"] == 0.0
+
+
+def test_half_open_failure_reopens_with_doubled_cooldown():
+    now = [0.0]
+    b = BreakerBoard(cfg=_cfg(cooldown_secs=5.0, max_cooldown_secs=8.0),
+                     clock=lambda: now[0])
+    b.record_failure("hostB")
+    b.record_failure("hostB")
+    assert b.snapshot()["hostB"]["cooldown"] == 5.0
+    now[0] = 5.1
+    assert b.allow("hostB")                   # half-open probe
+    b.record_failure("hostB")                 # failed probe: re-open
+    assert b.state("hostB") == "open"
+    assert b.snapshot()["hostB"]["cooldown"] == 8.0  # doubled, capped
+
+
+def test_probe_budget_bounds_half_open_calls():
+    now = [0.0]
+    b = BreakerBoard(cfg=_cfg(probes=2), clock=lambda: now[0])
+    b.record_failure("hostB")
+    b.record_failure("hostB")
+    now[0] = 5.1
+    assert b.allow("hostB")
+    assert b.allow("hostB")
+    assert not b.allow("hostB")               # budget of 2 spent
+
+
+def test_corruption_outweighs_slowness():
+    """crc_mismatch carries weight 2.0: a peer shipping bad bytes trips
+    the breaker in ONE failure at the default-ish threshold."""
+    b = BreakerBoard(cfg=_cfg(threshold=2.0))
+    b.record_failure("hostB", "crc_mismatch")
+    assert b.state("hostB") == "open"
+
+
+def test_success_decays_the_failure_score():
+    """Occasional blips on a busy edge never accumulate to a trip."""
+    b = BreakerBoard(cfg=_cfg(threshold=2.0))
+    for _ in range(4):
+        b.record_failure("hostB", "timeout")  # score +1
+        b.record_ok("hostB")                  # score halved
+    assert b.state("hostB") == "closed"
+
+
+def test_gray_latency_floor_counts_successes_as_failures():
+    """The gray-host case proper: calls that 'succeed' above the
+    latency floor quarantine the peer anyway."""
+    b = BreakerBoard(cfg=_cfg(threshold=2.0, lat_floor_secs=0.01))
+    b.record_ok("hostB", latency_s=5.0)
+    b.record_ok("hostB", latency_s=5.0)
+    assert b.state("hostB") == "open"
+
+
+def test_unknown_peer_is_closed_and_allowed():
+    b = BreakerBoard(cfg=_cfg())
+    assert b.allow("never-seen")
+    assert b.state("never-seen") == "closed"
+    assert not b.quarantined("never-seen")
+    assert b.allow("")                        # addressless: always allowed
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (fleet/drain.py, injected exit_fn; no real exit)
+# ---------------------------------------------------------------------------
+
+
+class _FakeManager:
+    def ready_models(self):
+        return []
+
+
+def _run_drain(timeout_s=0.1):
+    from aios_tpu.fleet import drain
+
+    exits = []
+    coord = drain.DrainCoordinator(_FakeManager(), exit_fn=exits.append)
+    phase = coord.request_drain(timeout_s)
+    t = coord._thread
+    assert t is not None
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    return coord, phase, exits
+
+
+def test_drain_walks_the_phase_ladder_and_exits_zero():
+    from aios_tpu.serving import admission
+
+    try:
+        coord, phase, exits = _run_drain()
+        assert phase == "draining"
+        assert coord.phase() == "leaving"
+        assert exits == [0]
+        # the front door closed while the protocol ran
+        assert admission.host_draining()
+    finally:
+        admission.set_host_draining(False)
+
+
+def test_drain_is_idempotent():
+    from aios_tpu.serving import admission
+
+    try:
+        coord, _, exits = _run_drain()
+        # a second POST reports the terminal phase, starts nothing new
+        t1 = coord._thread
+        assert coord.request_drain() == "leaving"
+        assert coord._thread is t1
+        assert exits == [0]
+    finally:
+        admission.set_host_draining(False)
+
+
+def test_unarmed_module_surface_stays_serving():
+    from aios_tpu.fleet import drain
+
+    drain.disarm()
+    assert drain.phase() == "serving"
+    assert not drain.draining()
+    assert drain.request_drain() == "serving"
+
+
+def test_arm_and_module_phase_follow_coordinator():
+    from aios_tpu.fleet import drain
+    from aios_tpu.serving import admission
+
+    try:
+        exits = []
+        drain.arm(_FakeManager(), exit_fn=exits.append)
+        assert drain.phase() == "serving"
+        assert drain.request_drain(0.05) == "draining"
+        assert drain.draining()
+        t = drain.COORD._thread
+        t.join(timeout=10.0)
+        assert drain.phase() == "leaving"
+        assert exits == [0]
+    finally:
+        drain.disarm()
+        admission.set_host_draining(False)
+
+
+def test_admission_sheds_with_the_draining_host_cause():
+    from aios_tpu.serving import admission
+    from aios_tpu.serving.admission import AdmissionController, AdmissionError
+    from aios_tpu.serving.config import ServingConfig
+
+    adm = AdmissionController(ServingConfig(), "drainmodel")
+    adm.check_host_drain()                    # healthy: no-op
+    admission.set_host_draining(True)
+    try:
+        with pytest.raises(AdmissionError) as err:
+            adm.check_host_drain()
+        assert err.value.cause == "draining_host"
+        assert err.value.retriable
+    finally:
+        admission.set_host_draining(False)
+    adm.check_host_drain()

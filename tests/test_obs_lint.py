@@ -506,7 +506,10 @@ def test_recorder_event_kinds_bounded():
     flightrec.EVENT_KINDS enum."""
     from aios_tpu.engine import batching, engine as engine_mod
     from aios_tpu.faults import inject as faults_inject
+    from aios_tpu.faults import net as faults_net
+    from aios_tpu.fleet import breaker as fleet_breaker
     from aios_tpu.fleet import disagg as fleet_disagg
+    from aios_tpu.fleet import drain as fleet_drain
     from aios_tpu.fleet import kvx as fleet_kvx
     from aios_tpu.fleet import router as fleet_router
     from aios_tpu.obs import fleet, flightrec
@@ -515,8 +518,8 @@ def test_recorder_event_kinds_bounded():
 
     kinds = _call_site_kinds(
         batching, engine_mod, pool, runtime_service, flightrec,
-        failover, faults_inject, autoscale, fleet,
-        fleet_disagg, fleet_kvx, fleet_router,
+        failover, faults_inject, faults_net, autoscale, fleet,
+        fleet_breaker, fleet_disagg, fleet_drain, fleet_kvx, fleet_router,
     )
     assert kinds, "no recorder event call sites found"
     unknown = kinds - set(flightrec.EVENT_KINDS)
@@ -694,6 +697,12 @@ FLEET_EXPECTED = {
     "aios_tpu_fleet_kvx_bytes_total": ("counter", ("model", "direction")),
     "aios_tpu_fleet_kvx_failures_total": ("counter", ("model", "cause")),
     "aios_tpu_fleet_route_total": ("counter", ("model", "reason")),
+    # ISSUE 18 fault domains: the breaker gauge is an EDGE series —
+    # host is the OBSERVING side, peer the judged side (value = index
+    # into the closed BREAKER_STATES enum); the announce counter keys
+    # by peer address alone (the asymmetric-partition signature)
+    "aios_tpu_fleet_peer_breaker_state_total": ("gauge", ("host", "peer")),
+    "aios_tpu_fleet_announce_failures_total": ("counter", ("peer",)),
 }
 
 
@@ -745,6 +754,7 @@ def test_fleet_kvx_and_route_enums_closed_and_iterated_at_registration():
     assert kvx.KVX_DIRECTIONS == ("push", "pull")
     assert kvx.KVX_FAIL_CAUSES == (
         "unavailable", "timeout", "crc_mismatch", "decode_error", "empty",
+        "breaker_open",
     )
     assert router.FLEET_ROUTE_REASONS == (
         "local", "no_peer", "remote_pull", "handoff", "handoff_resume",
@@ -763,6 +773,42 @@ def test_fleet_kvx_and_route_enums_closed_and_iterated_at_registration():
         "route metric children must be pre-registered by iterating "
         "FLEET_ROUTE_REASONS"
     )
+
+
+def test_fault_domain_enums_closed_and_pinned():
+    """The ISSUE 18 fault-domain vocabularies are closed enums, pinned
+    here so growing any of them is a reviewed change: breaker states
+    (the gauge VALUE is an index into the tuple — order is part of the
+    contract), drain phases (descriptor ``phase`` values and the
+    /fleet/drain response vocabulary), the per-edge net fault points
+    (a subset of the faults.POINTS catalog), and the net surface /
+    string-param scoping keys the injector recognizes."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu import faults
+    from aios_tpu.faults import inject, net
+    from aios_tpu.fleet import breaker, drain
+
+    assert breaker.BREAKER_STATES == ("closed", "open", "half_open")
+    assert drain.DRAIN_PHASES == ("serving", "draining", "leaving")
+    assert net.NET_POINTS == (
+        "net.partition", "net.partition_oneway", "net.delay",
+        "net.drop_after",
+    )
+    assert set(net.NET_POINTS) <= set(faults.POINTS), (
+        "every net point must live in the faults.POINTS catalog so "
+        "_parse accepts it and the injected-total label stays closed"
+    )
+    assert net.SURFACES == ("rpc", "http")
+    assert inject._STR_PARAMS == ("src", "dst", "surface"), (
+        "the per-edge scoping params are the ONLY string-valued fault "
+        "params; anything else must stay a float"
+    )
+    # the gauge value and the emitted transition both come from the
+    # SAME tuple: _emit indexes BREAKER_STATES (checked on the AST)
+    bmi = module_info_for(breaker)
+    assert "BREAKER_STATES" in names_used_in(
+        bmi.functions["BreakerBoard._emit"].node
+    ), "breaker gauge values must be indices into BREAKER_STATES"
 
 
 def test_process_info_gauge_is_an_identity_series():
