@@ -81,6 +81,11 @@ def _default_sections() -> Dict[str, Dict[str, Any]]:
             # dynamic-n XLA graph (greedy-identical; opt-in). "" = off.
             "decode_pipeline": "",
             "unified_step": "",
+            # device-resident multi-tick decode megagraph: up to this
+            # many decode ticks per dispatch with on-device sampling,
+            # stop detection and budget/cap checks (early exit when no
+            # slot needs another tick; docs/ENGINE_PERF.md). "" = off.
+            "mega_ticks": "",
             # grammar jump-ahead for constrained/structured decoding
             # (multi-token forced runs in one dispatch; default ON) and
             # the radix-tree prefix index (default ON) — tri-state
@@ -287,6 +292,9 @@ def serving_env(cfg: "AiosConfig") -> Dict[str, str]:
         ("kv_sink_pages", "AIOS_TPU_KV_SINK_PAGES", False),
         ("kv_window_pages", "AIOS_TPU_KV_WINDOW_PAGES", False),
         ("seq_prefill_min", "AIOS_TPU_SEQ_PREFILL_MIN", True),
+        # an explicit 0 forwards (megagraph OFF, overriding a
+        # ModelConfig.mega_ticks default)
+        ("mega_ticks", "AIOS_TPU_MEGA_TICKS", True),
         # SLO autoscaler policy (serving/autoscale.py; only meaningful
         # with autoscale = true above)
         ("autoscale_max_replicas", "AIOS_TPU_AUTOSCALE_MAX_REPLICAS",

@@ -56,6 +56,15 @@ DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 JUMP_BUCKETS = (4, 16)
 assert JUMP_BUCKETS[-1] <= spec.HISTORY_PAD - 2
 
+# Device-side stop-id slots per batch slot in the mega decode loop
+# (_mega_impl): each slot's first MEGA_STOP_SLOTS stop ids ride the
+# dispatch as a fixed-shape [S, MEGA_STOP_SLOTS] operand (pad -1) so
+# EOS/stop detection runs on device. BEST-EFFORT by design: the host
+# emit loop stays authoritative for stream truncation (it checks the
+# FULL stop set), so an overflowing stop set only costs an early-exit
+# opportunity, never correctness.
+MEGA_STOP_SLOTS = 4
+
 # Width buckets for the standalone draft-KV bulk-ingest graphs: a freshly
 # admitted (or failed-over) slot's draft cache trails the serving state by
 # the whole prompt, and spec_step_draft catches it up in these power-of-
@@ -276,7 +285,7 @@ class PendingDecode:
     submit time."""
 
     __slots__ = ("_fut", "_started", "n_steps", "tokens", "lengths",
-                 "device_s")
+                 "ticks", "device_s")
 
     def __init__(self, fut, n_steps: int, started: threading.Event) -> None:
         self._fut = fut
@@ -284,6 +293,10 @@ class PendingDecode:
         self.n_steps = int(n_steps)
         self.tokens: Optional[np.ndarray] = None
         self.lengths: Optional[np.ndarray] = None
+        # REAL ticks the dispatch ran: n_steps for the scan graphs, the
+        # device loop's k <= n_steps for a megagraph dispatch that
+        # early-exited (mega_step_async); set at wait()
+        self.ticks = int(n_steps)
         self.device_s: Optional[float] = None
 
     def wait_started(self) -> None:
@@ -293,7 +306,11 @@ class PendingDecode:
 
     def wait(self) -> np.ndarray:
         if self.tokens is None:
-            self.tokens, self.lengths, self.device_s = self._fut.result()
+            res = self._fut.result()
+            if len(res) == 4:  # mega: (tokens, lengths, k, device_s)
+                self.tokens, self.lengths, self.ticks, self.device_s = res
+            else:
+                self.tokens, self.lengths, self.device_s = res
         return self.tokens
 
 
@@ -326,6 +343,7 @@ class TPUEngine:
         kv_sink_pages: Optional[int] = None,  # live leading (sink) pages
         kv_window_pages: Optional[int] = None,  # live trailing window pages
         seq_prefill_min: Optional[int] = None,  # sp-sharded prefill floor rows
+        mega_ticks: Optional[int] = None,  # multi-tick decode megagraph cap
     ) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
@@ -724,6 +742,17 @@ class TPUEngine:
             seq_prefill_min, "AIOS_TPU_SEQ_PREFILL_MIN",
             getattr(cfg, "seq_prefill_min", 0),
         )
+        # Device-resident multi-tick decode megagraph (_mega_impl): up to
+        # mega_ticks decode ticks per dispatch in one lax.while_loop with
+        # sampling, stop detection and budget/cap checks on device, early
+        # exit the moment no slot needs another tick. 0 = off (default).
+        # The loop's key fanout is split(key, K+1) — identical to the
+        # per-size scan graph of the same K, so a full-window mega
+        # dispatch is key-for-key the _step_impl(K) dispatch.
+        self.mega_ticks = max(knob(
+            mega_ticks, "AIOS_TPU_MEGA_TICKS",
+            getattr(cfg, "mega_ticks", 0),
+        ), 0)
         self._seq_attn = None
         self._seq_prefill_fns: Dict[int, object] = {}
         self.prefill_seq_sharded = 0
@@ -876,6 +905,7 @@ class TPUEngine:
         self._spec_fns: Dict[Tuple[int, int, int], object] = {}
         self._restore_fns: Dict[int, object] = {}
         self._jump_fns: Dict[int, object] = {}  # run-length-bucketed
+        self._mega_fns: Dict[int, object] = {}  # pow2 K-bucketed megagraphs
         # Unified decode graph: ONE compiled fori_loop over a static
         # max-steps bound with the actual step count as a DYNAMIC operand,
         # so every chunk size the batcher dispatches shares a single XLA
@@ -967,6 +997,12 @@ class TPUEngine:
         # jump_tokens/jump_dispatches masked single-token dispatches
         self.jump_dispatches = 0
         self.jump_tokens = 0
+        # multi-tick megagraph accounting (mega_step): dispatches and the
+        # REAL ticks they ran (k <= K when the device loop early-exited);
+        # dispatches * K - mega_tick_total = ticks the early-exit contract
+        # saved. Distinct attribute names from the mega_ticks knob above.
+        self.mega_dispatches = 0
+        self.mega_tick_total = 0
         # XLA compile-event accounting: every new jit graph counts once
         # and its FIRST dispatch's wall time — jax compiles synchronously
         # inside that call — is recorded as the compile stall. stats(),
@@ -1027,6 +1063,12 @@ class TPUEngine:
         )
         obs.ENGINE_JUMP_TOKENS.labels(model=name).set_function(
             engines_sum("jump_tokens")
+        )
+        obs.ENGINE_MEGA_DISPATCHES.labels(model=name).set_function(
+            engines_sum("mega_dispatches")
+        )
+        obs.ENGINE_MEGA_TICKS.labels(model=name).set_function(
+            engines_sum("mega_tick_total")
         )
         # long-context tier: compression + sequence-sharded prefill
         # counters (same WeakSet-summed monotonic-engine-counter pattern)
@@ -1353,6 +1395,66 @@ class TPUEngine:
             0, jnp.minimum(n, max_steps), body, (state, out0)
         )
         return state, tokens  # tokens [max_steps, S]; rows [n:] are zeros
+
+    def _mega_impl(self, params, state: DecodeState, n, stops, budgets,
+                   abort_after, max_ticks: int, tables=None):
+        """Device-resident multi-tick decode megagraph: up to ``n`` (a
+        traced operand, n <= max_ticks) applications of ``_decode_body``
+        under one ``lax.while_loop``, emitting into a fixed
+        [max_ticks, S] token buffer — sampling, EOS/stop-sequence
+        detection, per-slot token-budget and context-cap checks all run
+        ON DEVICE, and the loop EXITS EARLY the moment no slot needs
+        another tick, returning the real tick count ``k`` in the
+        readback (the early-exit contract; the batcher's flush causes
+        become loop-exit conditions instead of pipeline flushes).
+
+        Per-slot live flags: a slot stays live while it is active, has
+        not sampled one of its ``stops`` ids ([S, MEGA_STOP_SLOTS]
+        int32, pad -1 — best-effort, the host emit loop stays
+        authoritative), still has token budget (``budgets`` [S] int32,
+        remaining = max_tokens - produced) and is below the context cap.
+        ``abort_after`` (int32, normally n) is the injectable
+        host-attention override: ``pool.megatick_abort`` caps the loop
+        mid-window through it, exercising the early-exit path
+        deterministically.
+
+        The key fanout is ``split(key, max_ticks + 1)`` — the SAME
+        fanout as ``_step_impl(max_ticks)`` — so a full-window mega
+        dispatch is key-for-key identical to the per-size scan graph of
+        the same size; early exits only ever skip ticks whose tokens the
+        host would have discarded (every live slot done). Composes with
+        the shard_map ragged-attention twin (``self._attn_impl``) and
+        the paged pool exactly like the scan graphs: ``_decode_body`` is
+        the shared body, so dp/tp-sharded plans serve the megagraph
+        natively instead of silently falling back."""
+        keys = jax.random.split(state["key"], max_ticks + 1)
+        state = dict(state, key=keys[0])
+        cap = jnp.minimum(jnp.minimum(n, abort_after), max_ticks)
+        ctx_cap = self.max_context - 1
+
+        def live(st, done, rem):
+            return st["active"] & ~done & (rem > 0) & (st["lengths"] < ctx_cap)
+
+        def cond(carry):
+            i, st, _, done, rem = carry
+            return (i < cap) & jnp.any(live(st, done, rem))
+
+        def body(carry):
+            i, st, out, done, rem = carry
+            st, tok = self._decode_body(params, st, keys[i + 1], tables)
+            out = out.at[i].set(tok)
+            done = done | jnp.any(tok[:, None] == stops, axis=1)
+            return i + 1, st, out, done, rem - 1
+
+        out0 = jnp.zeros((max_ticks, self.num_slots), jnp.int32)
+        done0 = jnp.zeros((self.num_slots,), jnp.bool_)
+        k, state, tokens, _, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), state, out0, done0,
+             jnp.asarray(budgets, jnp.int32)),
+        )
+        # tokens [max_ticks, S]; rows [k:] are zeros and never read back
+        return state, tokens, k
 
     def _verify_moe_impl(self, feed_width: int):
         """The gathered-MoE crossover gate shared by every verify-shaped
@@ -2055,6 +2157,21 @@ class TPUEngine:
             donate_argnums=(1,),
         )
 
+    def _make_mega_jit(self, max_ticks: int):
+        if self.paged:
+            return jax.jit(
+                lambda p, s, t, n, st_, b, a: self._mega_impl(
+                    p, s, n, st_, b, a, max_ticks, t
+                ),
+                donate_argnums=(1,),
+            )
+        return jax.jit(
+            lambda p, s, n, st_, b, a: self._mega_impl(
+                p, s, n, st_, b, a, max_ticks
+            ),
+            donate_argnums=(1,),
+        )
+
     def _make_spec_jit(self, key: Tuple[int, int, int]):
         if self.paged:
             return jax.jit(
@@ -2255,6 +2372,37 @@ class TPUEngine:
             tuple(args),
         )
 
+    def mega_bucket(self, n: int) -> int:
+        """The power-of-two megagraph bucket serving an ``n``-tick
+        window (the smallest compiled K >= n; the dispatch passes the
+        true n as a dynamic operand)."""
+        m = 1
+        while m < n:
+            m *= 2
+        return m
+
+    def compile_mega_fn(self, k_bucket: int) -> None:
+        """Ensure the ``k_bucket``-tick megagraph exists WITHOUT
+        dispatching (warmup compiles every power-of-two bucket up to
+        ``mega_ticks``; the batcher attach calls this for its own
+        window sizes — the flat-compile-counters invariant). No-op when
+        the megagraph is disarmed (``mega_ticks`` = 0)."""
+        if k_bucket in self._mega_fns or not self.mega_ticks:
+            return
+        args = [self.params, self.state]
+        if self.paged:
+            args.append(self._tables_operand())
+        args += [
+            jnp.int32(k_bucket),
+            jnp.full((self.num_slots, MEGA_STOP_SLOTS), -1, jnp.int32),
+            jnp.zeros((self.num_slots,), jnp.int32),
+            jnp.int32(k_bucket),
+        ]
+        self._compile_aot(
+            "mega", self._mega_fns, k_bucket,
+            self._make_mega_jit(k_bucket), tuple(args),
+        )
+
     def compile_prefill_fn(self, bucket: int) -> None:
         if bucket in self._prefill_fns:
             return
@@ -2371,6 +2519,17 @@ class TPUEngine:
                 fn = self._instrument_compile(jitfn, "step")
                 self._step_fns[key] = fn
             self._unified_max = m
+        return fn, m
+
+    def _mega_fn(self, n_ticks: int):
+        """The megagraph serving an ``n_ticks`` window: the power-of-two
+        bucket >= n_ticks, compiled lazily on an unwarmed engine.
+        Returns (fn, bucket)."""
+        m = self.mega_bucket(n_ticks)
+        fn = self._mega_fns.get(m)
+        if fn is None:
+            fn = self._instrument_compile(self._make_mega_jit(m), "mega")
+            self._mega_fns[m] = fn
         return fn, m
 
     def _masked_step_fn(self):
@@ -3226,6 +3385,108 @@ class TPUEngine:
         )
         return PendingDecode(fut, n_steps, started)
 
+    def _mega_dispatch(
+        self, n_ticks: int, stops: np.ndarray, budgets: np.ndarray,
+        started: Optional[threading.Event] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, int, Optional[float]]:
+        """The megagraph dispatch body: lock, while-loop graph call
+        (donated state swap), the k readback, host-length advance by the
+        REAL tick count, then the token-block readback outside the lock.
+        Returns (tokens [k, S], per-tick length snapshots [k, S], k,
+        sample_s). Unlike ``_step_dispatch``, the scalar k readback
+        blocks UNDER the engine lock — the host-length advance depends
+        on it, and the CPU backend already executes the graph inline
+        under the lock in ``_step_dispatch``; on TPU this serializes
+        admissions behind the window's device execution (the documented
+        K>1 tradeoff, docs/ENGINE_PERF.md)."""
+        try:
+            with self._lock:
+                if started is not None:
+                    started.set()
+                tables = ()
+                if self.paged:
+                    self._back_active_slots(n_ticks)
+                    tables = (self._tables_operand(),)
+                abort_after = n_ticks
+                act = faults.point("pool.megatick_abort", self.cfg.name)
+                if act is not None and n_ticks > 1:
+                    # injected host-attention demand: cap the device loop
+                    # mid-window (ticks param, default half the window) —
+                    # the early-exit path fires with slots still live
+                    abort_after = min(
+                        max(act.ticks or n_ticks // 2, 1), n_ticks - 1
+                    )
+                fn, m = self._mega_fn(n_ticks)
+                dtok = self._devprof_note(
+                    "mega", m, need_slack=started is not None
+                )
+                self.state, tokens, k_dev = fn(
+                    self.params, self.state, *tables, jnp.int32(n_ticks),
+                    jnp.asarray(stops, jnp.int32),
+                    jnp.asarray(budgets, jnp.int32),
+                    jnp.int32(abort_after),
+                )
+                k = int(k_dev)
+                self.mega_dispatches += 1
+                self.mega_tick_total += k
+                self.decode_steps += k
+                self._obs_decode_steps.inc(k)
+                base = self._host_lengths.copy()
+                self._host_lengths = np.minimum(
+                    base + k, self.max_context - 1
+                )
+            # per-tick length snapshots: row j holds every slot's length
+            # AS OF tick j, so retirement anchors on the dispatch tick
+            # that produced each token (the K=1 loop's post-dispatch
+            # snapshot, per tick) — never on the window's requested n
+            lengths = np.minimum(
+                base[None, :] + np.arange(1, k + 1, dtype=np.int64)[:, None],
+                self.max_context - 1,
+            )
+            host_tokens = np.asarray(tokens)[:k]
+            sample_s = self._devprof_sample(dtok)
+            return host_tokens, lengths, k, sample_s
+        finally:
+            if started is not None and self._devprof is not None:
+                self._devprof.dequeue()
+
+    def mega_step(
+        self, n_ticks: int, stops: np.ndarray, budgets: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Run up to ``n_ticks`` decode ticks in ONE device-resident
+        while-loop dispatch (the multi-tick megagraph, ``_mega_impl``)
+        with early exit the moment no slot needs another tick.
+
+        ``stops`` [num_slots, MEGA_STOP_SLOTS] int32 carries each slot's
+        stop ids (pad -1); ``budgets`` [num_slots] int32 the remaining
+        token budget per slot. Returns (tokens [k, num_slots], per-tick
+        length snapshots [k, num_slots], k) where k <= n_ticks is the
+        REAL tick count the loop ran."""
+        tokens, lengths, k, _ = self._mega_dispatch(n_ticks, stops, budgets)
+        return tokens, lengths, k
+
+    def mega_step_async(
+        self, n_ticks: int, stops: np.ndarray, budgets: np.ndarray,
+    ) -> PendingDecode:
+        """``mega_step`` on the engine's dispatch worker thread —
+        the same depth-2 pipelined contract as ``step_async``; the
+        returned handle's ``ticks`` holds the real k after ``wait()``
+        and ``lengths`` the per-tick [k, S] snapshots."""
+        if self._dispatch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._dispatch_pool = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=f"decode-dispatch-{self.cfg.name}",
+            )
+        started = threading.Event()
+        if self._devprof is not None:
+            self._devprof.enqueue()
+        fut = self._dispatch_pool.submit(
+            self._mega_dispatch, n_ticks, stops, budgets, started
+        )
+        return PendingDecode(fut, n_ticks, started)
+
     def step_masked(self, mask: np.ndarray) -> np.ndarray:
         """One batched decode step with a per-slot ADDITIVE logits mask
         [num_slots, vocab] fp32 (0 = allowed, -inf = forbidden) applied
@@ -3579,6 +3840,11 @@ class TPUEngine:
         if self.jump_dispatches:
             out["jump_dispatches"] = self.jump_dispatches
             out["jump_tokens"] = self.jump_tokens
+        if self.mega_dispatches:
+            out["mega_dispatches"] = self.mega_dispatches
+            # REAL ticks run (k per dispatch, <= K on early exit);
+            # mega_ticks * dispatches - this = the early-exit savings
+            out["mega_ticks"] = self.mega_tick_total
         if self.allocator is not None:
             out["kv_pages_in_use"] = self.allocator.pages_in_use()
             out["kv_pages_free"] = self.allocator.free_pages
@@ -3646,6 +3912,7 @@ class TPUEngine:
             self._spec_fns.clear()
             self._restore_fns.clear()
             self._jump_fns.clear()
+            self._mega_fns.clear()
             self._draft_fns.clear()
             self._seq_prefill_fns.clear()
             self._seq_attn = None
@@ -3697,8 +3964,9 @@ class TPUEngine:
         backfill per bucket + the prefix-chunk tail graphs), every
         ``step_sizes`` decode graph (ONE dynamic-n graph in unified_step
         mode), the grammar-masked step when ``masked_step``, speculative
-        round graphs for ``spec_sizes``, and the host-tier restore
-        scatter buckets.
+        round graphs for ``spec_sizes``, every power-of-two multi-tick
+        megagraph bucket when ``mega_ticks`` is armed, and the host-tier
+        restore scatter buckets.
         """
         t0 = time.perf_counter()
         before = self.compile_events
@@ -3754,6 +4022,18 @@ class TPUEngine:
             jump_sizes = JUMP_BUCKETS if (masked_step and enabled) else ()
         for k in jump_sizes:
             self.compile_jump_fn(k)
+        if self.mega_ticks:
+            # every power-of-two megagraph bucket up to the armed cap:
+            # the batcher's window is min(chunk, mega_ticks) so the top
+            # bucket covers it, and short tails (budget remainders,
+            # admission windows) bucket downward — a size missing here
+            # would compile on the scheduler thread mid-serving, exactly
+            # the stall the flat-compile-counters gate exists to catch
+            m = 1
+            top = self.mega_bucket(self.mega_ticks)
+            while m <= top:
+                self.compile_mega_fn(m)
+                m *= 2
         for n in spec_sizes:
             self.compile_spec_fn(n, spec_draft_len, spec_ngram)
             # the draft proposer serves the same round sizes; its n-gram

@@ -744,7 +744,11 @@ def decode_step(
     valid rows (with sliding window if configured), and returns
     (logits [B, V] fp32, k_cache', v_cache'[, (k_scales', v_scales')]).
     Intended to be jitted with the caches donated so XLA updates them in
-    place.
+    place. Besides the single-dispatch scan, this is the body the
+    multi-tick decode megagraph (TPUEngine._mega_impl) iterates under
+    lax.while_loop — keep it free of host callbacks and shape-dependent
+    Python branching on traced values, or the K-tick window stops
+    lowering to one device program.
 
     ``active`` — slots marked False write their (ignored) K/V to the
     sacrificial last cache row and attend over zero rows, so an inactive or

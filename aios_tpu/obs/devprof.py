@@ -86,6 +86,7 @@ GRAPH_KINDS = (
     "draft_spec",    # fused draft-model propose+verify rounds
     "draft_ingest",  # bulk draft-KV catch-up writes
     "jump",          # grammar jump-ahead multi-token verify
+    "mega",          # multi-tick decode megagraph (K ticks per dispatch)
     "restore",       # host-tier KV restore scatters
     "hist",          # prefix-hit history backfill
 )

@@ -1,0 +1,337 @@
+"""Device-resident multi-tick decode megagraph (ISSUE 19).
+
+Guarantees under test:
+  * token identity: with ``mega_ticks`` armed the batcher runs up to K
+    decode ticks per dispatch inside one ``lax.while_loop`` — sampling,
+    stop detection, budget/context-cap checks on device — and every
+    stream (greedy, sampled, schema-constrained; pipelined and sync) is
+    byte-identical to the K=1 loop. The megagraph's key fanout
+    (``split(key, K+1)``) matches the per-size scan graph of the same
+    window, so sampled streams match key-for-key.
+  * early exit: the loop returns after k <= K REAL ticks the moment no
+    live slot needs another tick (EOS/stop hit, budget exhausted,
+    context cap) or the ``pool.megatick_abort`` fault caps the window;
+    ``engine.mega_tick_total`` records k, never K.
+  * no compile after warmup: warmup AOT-builds every power-of-two
+    megagraph bucket, so a mega-armed serving sweep moves the compile
+    counters by exactly zero.
+  * shard_map twin: a dp/tp-sharded plan with the shard_mapped ragged
+    decode attention runs the SAME megagraph (``_decode_body`` composes
+    ``_attn_impl``) — no silent fallback, identical tokens.
+  * failover: a replica crash mid-megadispatch resumes the stream from
+    the tokens already emitted, token-identical to a fault-free run.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aios_tpu import faults
+from aios_tpu.engine import model as M
+from aios_tpu.engine.batching import ContinuousBatcher, Request
+from aios_tpu.engine.config import TINY_TEST
+from aios_tpu.engine.engine import TPUEngine
+from aios_tpu.engine.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+# distinct model name: the eviction test below ABORTS a request, which
+# freezes a flight-recorder anomaly snapshot and claims the global
+# per-(model, cause) SNAPSHOT_COOLDOWN — under TINY_TEST.name that
+# cooldown would swallow test_obs_flightrec's own abort snapshot when
+# this module runs within 30s of it
+MEGA_TEST = TINY_TEST.scaled(name="mega-test")
+
+
+def make_engine(params, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return TPUEngine(MEGA_TEST, params, **kw)
+
+
+def run_batch(params, mega, reqs, *, pipeline=True, engine_kw=None,
+              batcher_kw=None, tokenizer=None, warm=True):
+    """One engine+batcher lifecycle with ``mega_ticks=mega``. The
+    batcher's dispatch window (chunk_steps=8 == admit_chunk_steps=8)
+    equals the armed K, so the mega arm's key fanout matches the off
+    arm's scan graph and sampled streams can be compared byte-for-byte."""
+    ekw = dict(engine_kw or {})
+    ekw["mega_ticks"] = mega
+    eng = make_engine(params, **ekw)
+    if warm:
+        eng.warmup(step_sizes=(8,), prefill_chunk=32,
+                   masked_step=tokenizer is not None)
+    kw = dict(chunk_steps=8, admit_chunk_steps=8, pipeline=pipeline,
+              tokenizer=tokenizer)
+    kw.update(batcher_kw or {})
+    b = ContinuousBatcher(eng, **kw)
+    try:
+        handles = [b.submit(Request(**r)) for r in reqs]
+        outs = [h.tokens() for h in handles]
+        stats = dict(eng.stats())
+        stats["flushes"] = b.flushes
+        stats["dispatches"] = b.decode_dispatches
+        stats["evictions"] = b.pool_evictions
+        stats["aborted"] = [h.abort_reason for h in handles]
+        return outs, stats
+    finally:
+        b.shutdown()
+        eng.close()
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_mega_token_identical_greedy(params, pipeline):
+    """Greedy streams with staggered retirement boundaries and a
+    mid-window stop token: mega_ticks=8 == mega_ticks=0, byte for byte,
+    in both the sync and the pipelined loop."""
+    reqs = [
+        dict(prompt_ids=[3 + i, 17, 91, 4 + i], max_tokens=18 + 5 * i,
+             temperature=0.0)
+        for i in range(4)
+    ]
+    off, _ = run_batch(params, 0, reqs, pipeline=pipeline)
+    # make one request stop early on a token the free run actually emits
+    reqs[1]["stop_ids"] = (off[1][4],)
+    off, _ = run_batch(params, 0, reqs, pipeline=pipeline)
+    on, s_on = run_batch(params, 8, reqs, pipeline=pipeline)
+    assert on == off
+    assert len(off[1]) <= 5 + 1  # the stop actually fired
+    assert s_on["mega_dispatches"] > 0  # the megagraph actually served
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_mega_token_identical_sampled(params, pipeline):
+    """temperature > 0 with the fixed engine seed: the megagraph's
+    split(key, K+1) fanout is the scan graph's, so sampled streams match
+    token-for-token."""
+    reqs = [
+        dict(prompt_ids=[7 + i, 2, 55], max_tokens=21 + 4 * i,
+             temperature=0.85, top_p=0.9)
+        for i in range(4)
+    ]
+    off, _ = run_batch(params, 0, reqs, pipeline=pipeline)
+    on, s_on = run_batch(params, 8, reqs, pipeline=pipeline)
+    assert on == off
+    assert any(len(set(t)) > 1 for t in on)  # actually sampled something
+    assert s_on["mega_dispatches"] > 0
+
+
+def test_mega_token_identical_constrained(params):
+    """A schema-constrained stream and its co-resident plain stream:
+    constrained ticks route through the masked/jump path in BOTH arms
+    (the mask depends on every emitted token), so arming mega must
+    change nothing — and the plain slot's megagraph ticks must not
+    perturb the constrained slot either."""
+    tok = ByteTokenizer()
+    reqs = [
+        dict(prompt_ids=tok.encode("emit json"), max_tokens=40,
+             temperature=0.9, top_p=0.95, stop_ids=(tok.eos_id,),
+             json_mode=True),
+        dict(prompt_ids=tok.encode("plain"), max_tokens=24,
+             temperature=0.0),
+    ]
+    off, _ = run_batch(params, 0, reqs, tokenizer=tok)
+    on, _ = run_batch(params, 8, reqs, tokenizer=tok)
+    assert on == off
+    parsed = json.loads(tok.decode(on[0]))
+    assert isinstance(parsed, dict)  # the constraint really constrained
+
+
+def test_mega_early_exit_on_budget_and_eos(params):
+    """A window whose every live slot retires mid-window (token budget,
+    then an EOS hit) makes the device loop exit after k < K real ticks:
+    mega_tick_total records k, never the requested window."""
+    reqs = [dict(prompt_ids=[9, 8, 7], max_tokens=3, temperature=0.0)]
+    outs, stats = run_batch(params, 8, reqs, pipeline=False)
+    assert len(outs[0]) == 3
+    assert stats["mega_dispatches"] >= 1
+    # prefill emits token 1; the window needed 2 more ticks of its 8
+    assert stats["mega_ticks"] < stats["mega_dispatches"] * 8
+    assert stats["mega_ticks"] == stats["decode_steps"]
+
+    # EOS mid-window: the device stop check (first MEGA_STOP_SLOTS stop
+    # ids) exits the loop on the tick that produced the stop token
+    free, _ = run_batch(
+        params, 0, [dict(prompt_ids=[5, 6, 7], max_tokens=32,
+                         temperature=0.0)], pipeline=False)
+    stop = free[0][4]
+    reqs = [dict(prompt_ids=[5, 6, 7], max_tokens=32, temperature=0.0,
+                 stop_ids=(stop,))]
+    off, _ = run_batch(params, 0, reqs, pipeline=False)
+    on, s_on = run_batch(params, 8, reqs, pipeline=False)
+    assert on == off and on[0][-1] == stop
+    assert s_on["mega_ticks"] < s_on["mega_dispatches"] * 8
+
+
+def test_megatick_abort_fault_forces_early_exit(params):
+    """The ``pool.megatick_abort`` catalog point caps the device loop's
+    abort_after operand mid-window: the dispatch returns early with
+    k < K, the batcher retires/streams off the k real ticks, and the
+    streams stay byte-identical to the unfaulted run (the remaining
+    ticks simply run in later dispatches)."""
+    reqs = [
+        dict(prompt_ids=[3 + i, 17, 91], max_tokens=20, temperature=0.0)
+        for i in range(3)
+    ]
+    clean, _ = run_batch(params, 8, reqs)
+    plan = faults.activate("seed=5;pool.megatick_abort=nth:1,ticks=2")
+    try:
+        out, stats = run_batch(params, 8, reqs)
+    finally:
+        faults.deactivate()
+    assert out == clean
+    fired = [e for e in plan.journal() if e["point"] == "pool.megatick_abort"]
+    assert fired, "the abort point never fired"
+    # the capped dispatch ran fewer ticks than its window
+    assert stats["mega_ticks"] < stats["mega_dispatches"] * 8
+
+
+def test_mega_eviction_mid_window_recovers(params):
+    """Pool exhaustion surfacing from a megadispatch: the eviction path
+    consumes the in-flight window first (the victim keeps every token it
+    produced), the survivor completes, and the engine stays coherent."""
+    reqs = [
+        dict(prompt_ids=list(range(1, 31)), max_tokens=50, temperature=0.0,
+             priority=1),
+        dict(prompt_ids=list(range(40, 70)), max_tokens=80, temperature=0.0),
+    ]
+    outs, stats = run_batch(
+        params, 8, reqs,
+        engine_kw=dict(num_slots=2, paged_pool_rows=128, page_size=32,
+                       prefix_cache=False),
+    )
+    assert stats["evictions"] >= 1
+    aborted = [r for r in stats["aborted"] if r]
+    assert aborted and "evicted" in aborted[0]
+    survivor = [o for o, r in zip(outs, stats["aborted"]) if not r]
+    assert survivor and len(survivor[0]) > 0
+    assert stats["mega_dispatches"] > 0
+
+
+def test_mega_no_compile_after_warmup_sweep(params):
+    """warmup AOT-builds every power-of-two megagraph bucket up to the
+    armed K, and attaching a batcher compiles its window buckets without
+    dispatching — a mega-armed serving wave moves the compile counters
+    by exactly zero."""
+    eng = make_engine(params, mega_ticks=8)
+    try:
+        eng.warmup(step_sizes=(2, 8), prefill_chunk=32)
+        before = eng.stats()["xla_compiles"]
+        b = ContinuousBatcher(eng, chunk_steps=8, admit_chunk_steps=2,
+                              pipeline=True)
+        try:
+            assert eng.stats()["xla_compiles"] == before  # attach is AOT
+            hs = [
+                b.submit(Request(prompt_ids=[3 + i, 4, 5],
+                                 max_tokens=12 + i, temperature=0.0))
+                for i in range(4)
+            ]
+            for h in hs:
+                h.tokens()
+        finally:
+            b.shutdown()
+        assert eng.mega_dispatches > 0
+        # every window size the wave dispatched (admit window 2 AND the
+        # full window 8, plus any early-exited k) hit a warmed bucket
+        assert eng.stats()["xla_compiles"] == before, (
+            "a megagraph bucket compiled mid-serving"
+        )
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_mega_shard_map_twin_identity(params, cpu_devices):
+    """A dp/tp plan with the shard_mapped ragged decode attention armed:
+    the megagraph composes ``_attn_impl`` inside ``_decode_body``, so
+    the sharded engine serves K-tick windows with NO silent fallback and
+    tokens identical to the unsharded megagraph run."""
+    from aios_tpu.parallel.sharding import ShardingPlan, build_mesh
+
+    reqs = [
+        dict(prompt_ids=[3 + i, 17, 91, 4 + i], max_tokens=16 + 3 * i,
+             temperature=0.0)
+        for i in range(2)
+    ]
+    plain, _ = run_batch(params, 8, reqs, pipeline=False)
+    plan = ShardingPlan(build_mesh(4, dp=2))
+    # sharded engines serve lazily (the repo-wide convention: AOT
+    # executables pin input shardings, and the post-prefill state's
+    # sharding differs from the steady-state one — the SAME limitation
+    # the plain step graphs have)
+    sharded, stats = run_batch(
+        params, 8, reqs, pipeline=False, warm=False,
+        engine_kw=dict(shardings=plan, sharded_attention=True),
+    )
+    assert sharded == plain
+    assert stats["mega_dispatches"] > 0
+
+
+def test_mega_failover_mid_megadispatch_resumes(params):
+    """A replica crash injected while megadispatches serve a 2-replica
+    pool: failover resumes every stream from the tokens already emitted,
+    token-identical to a fault-free run."""
+    from aios_tpu.serving import ReplicaPool, ServingConfig
+
+    name = "mega-failover-test"
+    cfg = TINY_TEST.scaled(name=name, max_context=128)
+
+    def build():
+        engines = [
+            TPUEngine(cfg, params, num_slots=4, max_context=128,
+                      cache_dtype=jnp.float32, mega_ticks=8)
+            for _ in range(2)
+        ]
+        return ReplicaPool(
+            name, engines,
+            lambda e: ContinuousBatcher(e, chunk_steps=8,
+                                        admit_chunk_steps=8),
+            ServingConfig(replicas=2, failover_retries=2),
+        )
+
+    def wave(pool, tag):
+        handles = [
+            pool.submit(Request(prompt_ids=[3 + i, 7, 11], max_tokens=24,
+                                temperature=0.0,
+                                request_id=f"{tag}-{i}"))
+            for i in range(4)
+        ]
+        streams = {}
+        threads = []
+        for i, h in enumerate(handles):
+            t = threading.Thread(
+                target=lambda i=i, h=h: streams.__setitem__(i, h.tokens()),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        stuck = 0
+        for t in threads:
+            t.join(timeout=120)
+            stuck += int(t.is_alive())
+        return [streams.get(i) for i in range(4)], handles, stuck
+
+    pool = build()
+    try:
+        ref, _, stuck = wave(pool, "ref")
+        assert stuck == 0 and all(len(s) == 24 for s in ref)
+        faults.activate("seed=2;pool.scheduler_crash=nth:4")
+        try:
+            out, handles, stuck = wave(pool, "crash")
+        finally:
+            faults.deactivate()
+        assert stuck == 0, "a request leaked through the crash"
+        assert out == ref, "failover streams must be token-identical"
+        assert not any(h.aborted for h in handles)
+        assert pool.restarts == 1
+    finally:
+        pool.shutdown()
