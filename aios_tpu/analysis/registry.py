@@ -100,6 +100,15 @@ LOCKS: Tuple[LockDecl, ...] = (
     # runs on its worker thread outside the lock
     LockDecl("drain", "aios_tpu.fleet.drain", "DrainCoordinator",
              "_lock"),
+    # tsdb: the series map and per-series ring/wheel deques — registry
+    # reads (which take metric locks) run before it, metric emission
+    # after release; queries copy points under it and aggregate outside
+    LockDecl("tsdb", "aios_tpu.obs.tsdb", "Tsdb", "_lock"),
+    # incidents: bundle deque, cooldown stamps, id counter — bundle
+    # construction (tsdb/recorder/faults/devprof reads) and metric/
+    # recorder emission always run outside it
+    LockDecl("incidents", "aios_tpu.obs.incidents", "IncidentStore",
+             "_lock"),
 )
 
 

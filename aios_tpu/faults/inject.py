@@ -259,12 +259,17 @@ class FaultPlan:
         """Observability for a fired fault — outside the plan lock (the
         recorder and metric children take their own)."""
         obs.FAULTS_INJECTED.labels(point=act.point, mode=act.mode).inc()
-        from ..obs import flightrec  # late: obs.__init__ import order
+        from ..obs import flightrec, incidents  # late: obs import order
 
         flightrec.RECORDER.model_event(
             model or "faults", "fault",
             point=act.point, mode=act.mode, hit=act.hit,
         )
+        # a fired fault is an incident trigger — the bundle freezes the
+        # telemetry window around the injection (no-op when unarmed;
+        # the per-(model, cause) cooldown keeps fault storms bounded)
+        incidents.notify(model or "faults", "fault",
+                         point=act.point, mode=act.mode, hit=act.hit)
         log.warning(
             "fault injected: %s (%s, hit %d)%s",
             act.point, act.mode, act.hit,

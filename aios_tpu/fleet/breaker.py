@@ -281,7 +281,7 @@ class BreakerBoard:
         if not edges:
             return
         from ..faults import net
-        from ..obs import flightrec, instruments
+        from ..obs import flightrec, incidents, instruments
 
         host = net.self_host()
         for peer, frm, to, why in edges:
@@ -294,6 +294,11 @@ class BreakerBoard:
                 "fleet", "quarantine", peer=peer, frm=frm, to=to,
                 cause=why,
             )
+            if to == "open":
+                # a quarantined peer is exactly the moment to freeze
+                # the surrounding telemetry window (no-op when unarmed)
+                incidents.notify("fleet", "breaker_open",
+                                 peer=peer, why=why)
             log.warning("fleet peer breaker %s: %s -> %s (%s)",
                         peer, frm, to, why or "?")
 

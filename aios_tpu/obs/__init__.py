@@ -31,7 +31,19 @@ package is that substrate for the aiOS-TPU stack:
                           detection, /metrics/fleet federation, and
                           cross-process trace stitching (the placement/
                           failover signal the multi-host data plane
-                          routes on).
+                          routes on);
+  * ``obs.tsdb``        — the black-box time-series ring: a background
+                          sampler over every registered instrument (raw
+                          ring cascading into a downsampled wheel),
+                          queried at /debug/tsdb with the closed-verb
+                          expression form and federated fleet-wide
+                          (armed by AIOS_TPU_TSDB, None-check off);
+  * ``obs.incidents``   — incident bundles: every anomaly trigger
+                          (snapshot, SLO breach, autoscale action,
+                          breaker open, crash-respawn, fired fault)
+                          freezes the tsdb window + flightrec snapshot +
+                          fault journal + devprof + lock-watchdog state
+                          into a bounded store at /debug/incidents.
 
 No third-party dependencies: prometheus_client is not in the image, so
 the registry is self-contained stdlib code.
@@ -58,6 +70,8 @@ from .http import start_metrics_server, maybe_start_metrics_server  # noqa: F401
 from . import flightrec  # noqa: F401
 from . import slo  # noqa: F401 - registers the recorder's SLO listener
 from . import fleet  # noqa: F401 - fleet membership/federation plane
+from . import tsdb  # noqa: F401 - black-box time-series ring
+from . import incidents  # noqa: F401 - anomaly incident bundles
 from .flightrec import RECORDER, FlightRecorder, Timeline  # noqa: F401
 
 # Wire the previously-dormant span-exporter hook: finished spans fold

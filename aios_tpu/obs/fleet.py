@@ -46,6 +46,7 @@ import random
 import socket
 import threading
 import time
+import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -70,6 +71,10 @@ _MAX_JOURNAL = 256
 # Announce/scrape bodies are bounded reads: a confused peer must not be
 # able to balloon the registry.
 _MAX_BODY_BYTES = 4 << 20
+
+# Worst-burn tenants per heartbeat: the announce payload must stay
+# small under tenant churn; fleetctl top merges each host's worst few.
+_MAX_SLO_TENANTS = 4
 
 
 def _env_float(name: str, default: float) -> float:
@@ -230,12 +235,16 @@ def _self_gprefix() -> Dict[str, dict]:
 
 def _self_slo() -> dict:
     """Compact SLO summary for the heartbeat: worst burn across models
-    and objectives (None while no window is evaluable) plus per-model
-    per-objective attainment."""
+    and objectives (None while no window is evaluable), per-model
+    per-objective attainment, and the worst few tenants by TTFT burn
+    (bounded — the heartbeat stays announce-sized; fleetctl top ranks
+    the fleet-wide union)."""
     from . import slo as slomod
 
     worst: Optional[float] = None
     models: Dict[str, dict] = {}
+    tenants: Dict[str, float] = {}  # "model/tenant" -> TTFT burn
+    target = slomod.ENGINE.cfg.target
     for m in slomod.ENGINE.models():
         ev = slomod.ENGINE.evaluate(m)
         att = {}
@@ -245,7 +254,18 @@ def _self_slo() -> dict:
                 b = v.get("burn_rate", 0.0)
                 worst = b if worst is None else max(worst, b)
         models[m] = att
-    return {"worst_burn": worst, "attainment": models}
+        for ten, row in slomod.ENGINE.tenants(m).items():
+            if row.get("samples", 0) < slomod.ENGINE.cfg.min_samples:
+                continue
+            burn = (1.0 - row.get("ttft_attainment", 1.0)) \
+                / max(1.0 - target, 1e-9)
+            tenants[f"{m}/{ten}"] = round(burn, 4)
+    out: dict = {"worst_burn": worst, "attainment": models}
+    if tenants:
+        out["tenants"] = dict(sorted(
+            tenants.items(), key=lambda kv: -kv[1]
+        )[:_MAX_SLO_TENANTS])
+    return out
 
 
 def _self_capacity() -> dict:
@@ -728,6 +748,43 @@ class FleetRegistry:
                 log.debug("fleet scrape of %s (%s) failed: %r",
                           host, addr, exc)
         return merge_expositions(sources)
+
+    def federate_tsdb(self, query: Dict[str, List[str]]) -> dict:
+        """The /debug/tsdb/fleet body: every live member answers the
+        SAME parsed query against its own ring, keyed by host and
+        annotated with role (the federate() discipline — breaker-gated
+        scrapes, a failing host is an absent key plus a scrape-failure
+        count, never a lost response)."""
+        from ..fleet import breaker
+        from . import instruments, tsdb as tsdb_mod
+
+        local, _ = tsdb_mod.handle_query(query)
+        hosts: Dict[str, dict] = {
+            self.identity["host"]: dict(
+                local, role=self.identity["role"]
+            ),
+        }
+        qs = urllib.parse.urlencode(query, doseq=True)
+        for host, role, addr in self._scrape_targets():
+            if not breaker.BOARD.allow(host):
+                continue
+            t0 = self.clock()
+            try:
+                got = _http_json(
+                    f"http://{addr}/debug/tsdb" + (f"?{qs}" if qs else ""),
+                    timeout=self.cfg.scrape_timeout,
+                )
+                breaker.BOARD.record_ok(host, self.clock() - t0)
+            except Exception as exc:  # noqa: BLE001 - an absent host IS
+                # the signal; the counter records the failed range read
+                breaker.BOARD.record_failure(host, "unavailable")
+                instruments.FLEET_SCRAPE_FAILURES.labels(
+                    host=host, role=role
+                ).inc()
+                log.debug("fleet tsdb fetch from %s failed: %r", host, exc)
+                continue
+            hosts[host] = dict(got, role=role)
+        return {"hosts": hosts}
 
     # -- trace stitching ------------------------------------------------------
 

@@ -89,6 +89,10 @@ EVENT_KINDS = (
     # "drain": a graceful-drain phase edge (serving -> draining ->
     # leaving) on the "fleet" pseudo-model lane (aios_tpu/fleet/drain.py)
     "drain",
+    # "incident": an incident bundle frozen on the model lane — the tsdb
+    # window + snapshot + fault journal + devprof + lock-watchdog state
+    # around an anomaly trigger (aios_tpu/obs/incidents.py)
+    "incident",
 )
 
 # Shed causes — THE closed enum; serving/admission.py raises with these
@@ -515,6 +519,15 @@ class FlightRecorder:
         }
         with self._lock:
             self._snapshots.append(snap)
+        # Every fired snapshot is also an incident trigger: the bundle
+        # freezes the tsdb window + fault journal + devprof state around
+        # the same anomaly. Hooked here — after the append — so the
+        # incident's flightrec section always finds the snapshot it
+        # belongs to. Late import: flightrec loads before incidents in
+        # the obs package; notify() is a no-op when the store is
+        # unarmed, and runs its own per-(model, cause) cooldown.
+        from . import incidents as _incidents
+        _incidents.notify(model, cause)
         dump_dir = os.environ.get("AIOS_TPU_FLIGHTREC_DUMP_DIR", "")
         if dump_dir:
             try:

@@ -44,7 +44,17 @@ Routes:
     capture status (``?model=``);
   * ``/debug/profile``   — start a bounded on-demand ``jax.profiler``
     capture (``?secs=N``, capped, one at a time → 409 while busy,
-    403 unless ``AIOS_TPU_DEVPROF_DUMP_DIR`` is set).
+    403 unless ``AIOS_TPU_DEVPROF_DUMP_DIR`` is set);
+  * ``/debug/tsdb``      — the black-box time-series ring
+    (``?name=&verb=&window=&match=k:v``; stats when no name; 404
+    until ``AIOS_TPU_TSDB`` arms obs/tsdb.py);
+  * ``/debug/tsdb/fleet`` — the same query answered by every live
+    fleet member, keyed by host (404 until fleet is armed);
+  * ``/debug/incidents`` — frozen incident bundles (``?id=`` for one
+    full bundle, metadata list otherwise; 404 until obs/incidents.py
+    is armed);
+  * ``/debug``           — the machine-readable route index: every row
+    of :data:`ROUTES` (tests pin the table complete).
 """
 
 from __future__ import annotations
@@ -61,6 +71,52 @@ from .metrics import REGISTRY, MetricsRegistry
 
 log = logging.getLogger("aios.obs")
 
+# THE route index — every path the handler serves, one (method, route,
+# one-line help) row per route. ``GET /debug`` renders this table, and
+# tests/test_obs_lint.py pins it complete against the handler source: a
+# new route without its row here fails CI, so the index can never rot
+# into a partial map of the endpoint.
+ROUTES = (
+    ("GET", "/metrics",
+     "Prometheus text exposition of the process registry"),
+    ("GET", "/metrics/fleet",
+     "federation: every live member's /metrics with a host label"),
+    ("GET", "/livez",
+     "pure liveness: 200 while the process answers"),
+    ("GET", "/healthz",
+     "JSON readiness probe; 503 when degraded or SLO-breached"),
+    ("GET", "/fleet/members",
+     "fleet membership table + transition journal"),
+    ("POST", "/fleet/announce",
+     "one member's heartbeat descriptor in, ours + known peers back"),
+    ("POST", "/fleet/drain",
+     "start this host's graceful drain (202 + phase, ?timeout=S)"),
+    ("GET", "/debug",
+     "this route index"),
+    ("GET", "/debug/requests",
+     "recent flight-recorder timelines (?model=&limit=&trace=)"),
+    ("GET", "/debug/trace",
+     "timelines as Chrome-trace JSON (?model=&limit=&snapshot=)"),
+    ("GET", "/debug/trace/fleet",
+     "one trace id stitched across the fleet (?trace=<id>)"),
+    ("GET", "/debug/spans",
+     "the finished-span ring (?name=&limit=)"),
+    ("GET", "/debug/slo",
+     "per-model objective evaluation + per-tenant breakdown"),
+    ("GET", "/debug/snapshots",
+     "frozen anomaly snapshots (?id= for one, metadata otherwise)"),
+    ("GET", "/debug/devprof",
+     "device-time attribution ledgers + capture status (?model=)"),
+    ("GET", "/debug/profile",
+     "bounded on-demand profiler capture (?secs=N; 403/409 gated)"),
+    ("GET", "/debug/tsdb",
+     "time-series query (?name=&verb=&window=&match=k:v; stats bare)"),
+    ("GET", "/debug/tsdb/fleet",
+     "the same tsdb query answered by every live member, per host"),
+    ("GET", "/debug/incidents",
+     "frozen incident bundles (?id= for one, metadata otherwise)"),
+)
+
 
 def _debug_response(
     path: str, query: dict,
@@ -70,7 +126,8 @@ def _debug_response(
     because the obs package __init__ imports THIS module before them
     (they are package-level imports everywhere else — every process
     importing aios_tpu.obs has them loaded)."""
-    from . import devprof, fleet, flightrec, slo, tracing
+    from . import devprof, fleet, flightrec, incidents, slo, tracing
+    from . import tsdb as tsdb_mod
 
     def q(name: str, default: str = "") -> str:
         return query.get(name, [default])[0]
@@ -82,7 +139,52 @@ def _debug_response(
             return default
 
     status = 200
-    if path == "/debug/requests":
+    if path == "/debug":
+        # the machine-readable index — one row per served route, straight
+        # from the ROUTES table the handler itself is pinned against
+        body = json.dumps({
+            "routes": [
+                {"method": m, "route": r, "help": h} for m, r, h in ROUTES
+            ],
+        })
+    elif path == "/debug/tsdb/fleet":
+        if fleet.FLEET is None:
+            body = json.dumps({"error": "fleet telemetry not armed"})
+            status = 404
+        else:
+            body = json.dumps(fleet.FLEET.federate_tsdb(query))
+    elif path == "/debug/tsdb":
+        payload, status = tsdb_mod.handle_query(query)
+        body = json.dumps(payload)
+    elif path == "/debug/incidents":
+        if incidents.STORE is None:
+            body = json.dumps({
+                "error": "incident store not armed "
+                         "(set AIOS_TPU_INCIDENTS=1 or AIOS_TPU_TSDB=1)",
+            })
+            status = 404
+        else:
+            incs = incidents.STORE.incidents()
+            inc_id = qint("id", 0)
+            if inc_id:
+                match = [b for b in incs if b["id"] == inc_id]
+                if match:
+                    body = json.dumps(match[0])
+                else:
+                    body = json.dumps({"error": "no such incident"})
+                    status = 404
+            else:
+                body = json.dumps({
+                    "incidents": [
+                        {k: b[k] for k in
+                         ("id", "model", "cause", "at", "fields")}
+                        | {"tsdb_series": len(b["tsdb"]["series"]),
+                           "snapshot_id":
+                               b["flightrec"].get("snapshot_id")}
+                        for b in incs
+                    ],
+                })
+    elif path == "/debug/requests":
         trace = q("trace")
         limit = qint("limit", 64)
         tls = flightrec.RECORDER.recent(
@@ -286,7 +388,7 @@ def start_metrics_server(
                     status = 503
                 body = json.dumps(payload).encode("utf-8")
                 ctype = "application/json"
-            elif path.startswith("/debug/"):
+            elif path == "/debug" or path.startswith("/debug/"):
                 try:
                     rendered = _debug_response(path, parse_qs(parsed.query))
                 except Exception as exc:  # noqa: BLE001 - debug routes
@@ -425,9 +527,14 @@ def maybe_start_metrics_server(
         # fleet announce) is how anything finds the endpoint
         log.info("%s metrics endpoint bound on port %d", service_name,
                  bound)
-        from . import fleet
+        from . import fleet, incidents, tsdb
 
         fleet.maybe_start(service_name, bound, host=host)
+        # the history planes ride the same arming pass: every real
+        # serving process comes through here, and both are env-gated
+        # no-ops (module global stays None) unless asked for
+        tsdb.maybe_start()
+        incidents.maybe_start()
         return server, bound
     except (OSError, OverflowError) as exc:  # taken port / port > 65535
         # the endpoint is optional: a taken/invalid port must not crash a

@@ -543,6 +543,58 @@ FAULTS_INJECTED = Counter(
     ("point", "mode"),
 )
 
+# -- black-box time series (obs/tsdb.py, docs/OBSERVABILITY.md) ------------
+# Armed by AIOS_TPU_TSDB; the ring samples every registered instrument,
+# including this family (its own bookkeeping is three series — noise-
+# free). The queries counter's ``verb`` label is the CLOSED
+# tsdb.QUERY_VERBS enum, pre-registered by iterating the tuple at ring
+# construction (the autoscale/SLO registration pattern); the series /
+# dropped gauges are fn-backed live state (monotonic for dropped).
+
+TSDB_SAMPLES = Counter(
+    "aios_tpu_tsdb_sample_passes_total",
+    "Sampler passes completed (one pass reads the whole registry and "
+    "appends one point per live series)",
+)
+TSDB_SERIES = Gauge(
+    "aios_tpu_tsdb_series_total",
+    "Series currently tracked by the ring (scrape-time; bounded by "
+    "AIOS_TPU_TSDB_MAX_SERIES)",
+)
+TSDB_DROPPED = Gauge(
+    "aios_tpu_tsdb_dropped_series_total",
+    "Distinct series refused by the cardinality cap (monotonic, "
+    "scrape-time) — the no-silent-truncation contract: a non-zero value "
+    "means the ring is blind to that many series",
+)
+TSDB_QUERIES = Counter(
+    "aios_tpu_tsdb_queries_total",
+    "/debug/tsdb expressions evaluated, by verb (the closed "
+    "tsdb.QUERY_VERBS enum: raw|rate|avg|min|max|p50|p90|p95|p99)",
+    ("verb",),
+)
+
+# -- incident bundles (obs/incidents.py, docs/OBSERVABILITY.md) ------------
+# ``cause`` is the CLOSED incidents.TRIGGER_CAUSES enum, pre-registered
+# by iterating the tuple at store construction; suppressed counts the
+# per-(model, cause) cooldown swallowing a trigger burst — fired +
+# suppressed is the true trigger rate.
+
+INCIDENTS = Counter(
+    "aios_tpu_incidents_total",
+    "Incident bundles frozen, by trigger cause (closed "
+    "incidents.TRIGGER_CAUSES enum; each bundle = tsdb window + "
+    "flightrec snapshot + fault journal + devprof + lock-watchdog "
+    "state, served at /debug/incidents)",
+    ("cause",),
+)
+INCIDENTS_SUPPRESSED = Counter(
+    "aios_tpu_incidents_suppressed_total",
+    "Triggers swallowed by the per-(model, cause) cooldown — a burst "
+    "freezes exactly one bundle; this counter keeps the rest visible",
+    ("cause",),
+)
+
 # -- orchestrator ----------------------------------------------------------
 
 GOAL_TASKS = Counter(

@@ -50,8 +50,10 @@ from typing import Callable, List, Optional, Tuple
 
 from ..analysis.locks import make_lock
 from ..obs import flightrec
+from ..obs import incidents as incidents_mod
 from ..obs import instruments as obs
 from ..obs import slo as slo_mod
+from ..obs import tsdb as tsdb_mod
 
 log = logging.getLogger("aios.serving")
 
@@ -357,9 +359,18 @@ class AutoscaleController:
         util = self.utilization(t)
         if util is not None:
             fields.update(util)
+        # When the tsdb ring is armed, annotate the decision with the
+        # recent burn trend — the journal then records not just the
+        # instantaneous burn the controller acted on but the direction
+        # it was heading (None when unarmed: zero cost on the hot path).
+        burn_trend = tsdb_mod.trend(
+            "aios_tpu_slo_burn_rate_ratio", {"model": self.pool.name},
+        )
         entry = dict(action=action, cause=cause,
                      burn=round(burn, 4) if burn is not None else None,
                      **fields)
+        if burn_trend is not None:
+            entry["burn_trend"] = burn_trend
         with self._lock:
             self._hold_up = 0
             self._hold_down = 0
@@ -370,6 +381,11 @@ class AutoscaleController:
         self._obs_actions[(action, cause)].inc()
         flightrec.RECORDER.model_event(
             self.pool.name, "autoscale", **entry
+        )
+        incidents_mod.notify(
+            self.pool.name, "autoscale",
+            action=action, autoscale_cause=cause,
+            burn=entry["burn"],
         )
         log.warning(
             "%s autoscale %s (%s): burn=%s level=%d replicas=%d",

@@ -1723,6 +1723,127 @@ def bench_mega():
     }
 
 
+def bench_tsdb():
+    """Tsdb ON/OFF overhead A/B (AIOS_TPU_TSDB, ISSUE 20): 8 concurrent
+    greedy requests per wave through the production pipelined batcher,
+    with ONE shared engine+batcher across both arms — the quantity under
+    test is the process-level sampler, not engine config. The OFF arm is
+    the unarmed module (TSDB None + no sampler thread = the zero-cost
+    contract); the ON arm runs the real background sampler over the
+    global registry at 20x the default cadence, so the measured overhead
+    upper-bounds production's.
+
+    Same pairing discipline as bench_dispatch (waves order-alternated,
+    median of per-pair tok/s ratios) because this container's CPU
+    availability swings ~2x on a seconds timescale. The sampler is
+    read-only on the serving path by construction, so the gate is
+    threefold: token streams identical across arms, ZERO post-warmup
+    compile events in either arm (a sampler that perturbed dispatch
+    shapes would recompile), and a median ratio ~1.0."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+    from aios_tpu.obs import tsdb as tsdb_mod
+    from aios_tpu.obs.tsdb import Tsdb, TsdbConfig
+
+    cfg = TINY_TEST.scaled(
+        name="micro-tsdb", num_layers=1, hidden_size=32,
+        intermediate_size=64, num_heads=2, num_kv_heads=1, head_dim=16,
+        vocab_size=256, max_context=512,
+    )
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    chunk, max_tokens, slots, pairs = 16, 256, 8, 9
+
+    ring_cfg = TsdbConfig()
+    ring_cfg.step_secs = 0.05  # 20x the default sampling rate
+    ring = Tsdb(cfg=ring_cfg)  # over the global registry, like production
+
+    def wave(batcher):
+        handles = [
+            batcher.submit(Request(prompt_ids=[3 + i, 17, 91],
+                                   max_tokens=max_tokens, temperature=0.0))
+            for i in range(slots)
+        ]
+        t0 = time.time()
+        out = [h.tokens() for h in handles]
+        return sum(len(t) for t in out) / (time.time() - t0), out
+
+    prev = tsdb_mod.install(None)
+    eng = TPUEngine(cfg, params, num_slots=slots, max_context=512,
+                    cache_dtype=jnp.float32)
+    batcher = None
+    try:
+        eng.warmup(step_sizes=(2, chunk), prefill_chunk=0)
+        batcher = ContinuousBatcher(eng, chunk_steps=chunk,
+                                    admit_chunk_steps=2, pipeline=True)
+        wave(batcher)  # steady state before any measured pair
+        compiles_warm = eng.compile_events
+        ratios, identical = [], True
+        tps = {False: [], True: []}
+        for pair in range(pairs):
+            order = (False, True) if pair % 2 == 0 else (True, False)
+            got = {}
+            for armed in order:
+                if armed:
+                    tsdb_mod.install(ring)
+                    ring.start()
+                else:
+                    ring.stop()
+                    tsdb_mod.install(None)
+                got[armed] = wave(batcher)
+            identical = identical and got[False][1] == got[True][1]
+            ratios.append(got[True][0] / max(got[False][0], 1e-9))
+            for armed in (False, True):
+                tps[armed].append(got[armed][0])
+        ring.stop()
+        tsdb_mod.install(None)
+        compile_delta = eng.compile_events - compiles_warm
+        stats = ring.stats()
+    finally:
+        ring.stop()
+        tsdb_mod.install(prev)
+        if batcher is not None:
+            batcher.shutdown()
+        eng.close()
+    ratios_sorted = sorted(ratios)
+    ratio = statistics.median(ratios)
+    q25 = ratios_sorted[len(ratios) // 4]
+    q75 = ratios_sorted[-1 - len(ratios) // 4]
+    log(f"[tsdb] off med {statistics.median(tps[False]):.0f} tok/s -> on "
+        f"med {statistics.median(tps[True]):.0f} tok/s; per-pair ratios "
+        f"{['%.2f' % r for r in ratios]}, median {ratio:.2f}x "
+        f"(IQR {q25:.2f}-{q75:.2f}); {stats['passes']} sample passes over "
+        f"{stats['series']} series; identical={identical}, "
+        f"post-warmup compiles={compile_delta}")
+    return {
+        "metric": "tsdb sampler ON/OFF A/B, continuous batcher "
+                  f"(batch {slots}, {chunk}-step dispatches, {pairs} "
+                  "order-alternated paired waves, sampler at "
+                  f"{ring_cfg.step_secs:g}s cadence, micro geometry)",
+        "value": round(ratio, 3),
+        "unit": "x tok/s (tsdb on vs off, median of paired waves)",
+        "vs_baseline": round(ratio, 3),
+        "tps_tsdb_off": round(statistics.median(tps[False]), 1),
+        "tps_tsdb_on": round(statistics.median(tps[True]), 1),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "ratio_iqr": [round(q25, 3), round(q75, 3)],
+        "sample_passes": int(stats["passes"]),
+        "series_sampled": int(stats["series"]),
+        "dropped_series": int(stats["dropped_series"]),
+        "tokens_identical": bool(identical),
+        "post_warmup_compiles": int(compile_delta),
+        "slo": slo_block("micro-tsdb"),
+        "cpu_cores": os.cpu_count(),
+    }
+
+
 def bench_devprof():
     """Device-time attribution (obs/devprof.py): emit the per-graph cost
     ledger as JSON — {dispatches, est FLOPs/bytes, sampled
@@ -2518,6 +2639,13 @@ def main() -> int:
                          "scripts/benchdiff.py regression-sentinel "
                          "input) + the devprof on/off overhead A/B "
                          "(assertion-free, CPU fallback fine, exit 0)")
+    ap.add_argument("--tsdb", action="store_true",
+                    help="run ONLY the tsdb sampler overhead A/B: one "
+                         "engine+batcher, tsdb off vs the real sampler "
+                         "thread at 20x cadence, order-alternated paired "
+                         "waves — token streams and post-warmup compile "
+                         "counts must be identical across arms "
+                         "(assertion-free, always exit 0)")
     ap.add_argument("--flight-dump", action="store_true",
                     help="run ONLY the flight-recorder smoke: a tiny "
                          "2-replica pool wave whose request timelines "
@@ -2583,6 +2711,16 @@ def main() -> int:
             log(f"[devprof] FAILED: {e!r}")
             emit({"metric": "devprof per-graph device-time ledger + "
                             "sampling overhead A/B",
+                  "value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
+                  "error": repr(e)[:300]})
+        return 0
+
+    if args.tsdb:
+        try:
+            emit(bench_tsdb())
+        except Exception as e:  # assertion-free: diagnose, never fail
+            log(f"[tsdb] FAILED: {e!r}")
+            emit({"metric": "tsdb sampler ON/OFF overhead A/B",
                   "value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
                   "error": repr(e)[:300]})
         return 0
@@ -2675,7 +2813,7 @@ def main() -> int:
     extra = [] if args.skip_mistral else [bench_mixed_tier, bench_spec_decode]
     extra.extend([
         bench_paged_kv, bench_host_tier, bench_longctx, bench_dispatch,
-        bench_mega, bench_devprof, bench_structured, bench_draft,
+        bench_mega, bench_tsdb, bench_devprof, bench_structured, bench_draft,
         bench_agent_ttft, bench_moe_gather, bench_int8_kv_ragged_ab,
         bench_orchestrator_e2e,
     ])

@@ -5,6 +5,8 @@
 Usage:
     scripts/fleetctl.py status      [--target HOST:PORT] [--json]
     scripts/fleetctl.py top         [--target HOST:PORT] [--json]
+    scripts/fleetctl.py history METRIC [--target HOST:PORT] [--host H]
+                                    [--window S] [--json]
     scripts/fleetctl.py drain-check [--target HOST:PORT] --host HOSTID
     scripts/fleetctl.py drain       [--target HOST:PORT] --host HOSTID
                                     [--timeout S] [--json]
@@ -21,8 +23,19 @@ symmetric, so any member renders the whole fleet.
                       unreachable.
   * ``top``         — per-host load: pool occupancy / waiting / degrade
                       rung, devprof MFU and device-seconds, SLO worst
-                      burn — sorted worst-burn-first so the sick host is
-                      the top row. Exit codes as ``status``.
+                      burn, and the megagraph early-exit savings
+                      (dispatches x K - ticks) — sorted worst-burn-first
+                      so the sick host is the top row; the worst few
+                      tenants by TTFT burn fleet-wide render below the
+                      table. Exit codes as ``status``.
+  * ``history``     — a sparkline table of METRIC's recent points per
+                      host (off ``/debug/tsdb/fleet``; requires
+                      ``AIOS_TPU_TSDB`` armed on the members), sorted
+                      worst-host-first (highest last value). ``--host``
+                      narrows to one host, ``--window`` bounds the range
+                      in seconds. Exit 0 with data, 1 when no host
+                      returned points (metric unknown / ring unarmed),
+                      2 when the target is unreachable.
   * ``drain-check`` — is ``--host`` safe to take down? Exit 0 when every
                       one of its pools reports zero waiting and zero
                       batch occupancy (idle), 1 when it still holds
@@ -93,6 +106,36 @@ def _pool_load(member: dict) -> tuple:
     return waiting, occupancy, degrade
 
 
+def _mega_savings(member: dict) -> Optional[int]:
+    """Megagraph early-exit savings summed across the member's pools:
+    dispatches x K - ticks (the decode ticks the early exit never ran).
+    None when no pool runs the megagraph."""
+    savings = None
+    for name, stats in (member.get("pools") or {}).items():
+        if name == "_error" or not isinstance(stats, dict):
+            continue
+        k = int(stats.get("mega_k", 0) or 0)
+        dispatches = int(stats.get("mega_dispatches", 0) or 0)
+        if not k or not dispatches:
+            continue
+        ticks = int(stats.get("mega_ticks", 0) or 0)
+        savings = (savings or 0) + dispatches * k - ticks
+    return savings
+
+
+def _worst_tenants(members: List[dict], limit: int = 5) -> List[dict]:
+    """Fleet-wide union of each heartbeat's worst-tenant slice, ranked
+    by TTFT burn (the noisy-neighbor answer ``top`` renders)."""
+    rows = []
+    for m in members:
+        for key, burn in ((m.get("slo") or {}).get("tenants") or {}).items():
+            model, _, tenant = key.partition("/")
+            rows.append({"host": m["host"], "model": model,
+                         "tenant": tenant, "burn": float(burn)})
+    rows.sort(key=lambda r: -r["burn"])
+    return rows[:limit]
+
+
 def _mfu_secs(member: dict) -> tuple:
     mfu: Optional[float] = None
     secs = 0.0
@@ -157,6 +200,7 @@ def cmd_top(data: dict, as_json: bool = False) -> int:
 
     ordered = sorted(members, key=burn, reverse=True)
     not_up = [m for m in members if m["state"] != "up"]
+    tenants = _worst_tenants(members)
     if as_json:
         out = []
         for m in ordered:
@@ -168,9 +212,11 @@ def cmd_top(data: dict, as_json: bool = False) -> int:
                 "worst_burn": b, "occupancy": occupancy,
                 "waiting": waiting, "degrade_level": degrade,
                 "mfu": mfu, "device_seconds": secs,
+                "mega_savings": _mega_savings(m),
             })
         print(json.dumps({
             "cmd": "top", "pass": not not_up, "members": out,
+            "tenants": tenants,
         }, sort_keys=True))
         return 0 if not not_up else 1
     rows = []
@@ -178,22 +224,122 @@ def cmd_top(data: dict, as_json: bool = False) -> int:
         waiting, occupancy, degrade = _pool_load(m)
         mfu, secs = _mfu_secs(m)
         b = (m.get("slo") or {}).get("worst_burn")
+        save = _mega_savings(m)
         rows.append([
             m["host"], m["state"],
             f"{b:.2f}" if b is not None else "-",
             f"{occupancy:.2f}", waiting, degrade,
             f"{mfu:.3f}" if mfu is not None else "-",
             f"{secs:.2f}",
+            save if save is not None else "-",
         ])
     _table(rows, ["HOST", "STATE", "BURN", "OCCUP", "WAIT", "DEGRADE",
-                  "MFU", "DEV_SECS"])
+                  "MFU", "DEV_SECS", "MEGA_SAVE"])
+    if tenants:
+        log("")
+        log("worst tenants by TTFT burn:")
+        for t in tenants:
+            log(f"  {t['model']}/{t['tenant']} on {t['host']}: "
+                f"burn={t['burn']:.2f}")
     print(json.dumps({
         "cmd": "top",
         "worst": ({"host": ordered[0]["host"], "burn": burn(ordered[0])}
                   if ordered and burn(ordered[0]) >= 0 else None),
+        "worst_tenant": tenants[0] if tenants else None,
         "pass": not not_up,
     }, sort_keys=True))
     return 0 if not not_up else 1
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 32) -> str:
+    """Min-max scaled block sparkline, downsampled to ``width`` by
+    bucket-averaging (the whole window must fit one table cell)."""
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        values = [
+            sum(chunk) / len(chunk)
+            for chunk in (
+                values[int(i * step):max(int((i + 1) * step),
+                                         int(i * step) + 1)]
+                for i in range(width)
+            )
+        ]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    return "".join(
+        _SPARK_BLOCKS[
+            int((v - lo) / span * (len(_SPARK_BLOCKS) - 1)) if span else 0
+        ]
+        for v in values
+    )
+
+
+def cmd_history(target: str, metric: str, host: str, window: float,
+                timeout: float, as_json: bool = False) -> int:
+    """Sparkline table of ``metric``'s recent points per host, off the
+    target's ``/debug/tsdb/fleet`` federation — worst host (highest last
+    value) first, one row per series."""
+    url = (f"http://{target}/debug/tsdb/fleet?name={metric}"
+           f"&verb=raw&window={max(window, 1.0):g}")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            data = json.loads(r.read().decode("utf-8"))
+    except Exception as exc:  # noqa: BLE001 - unreachable target is the
+        # operator's first answer, render it as such
+        log(f"history: cannot reach {target}: {exc!r}")
+        print(json.dumps({"cmd": "history", "metric": metric,
+                          "error": repr(exc)[:200]}, sort_keys=True))
+        return 2
+    rows = []
+    for h, answer in sorted((data.get("hosts") or {}).items()):
+        if host and h != host:
+            continue
+        if not isinstance(answer, dict):
+            continue
+        for s in answer.get("series") or []:
+            values = [pv for _, pv in s.get("points") or []]
+            if not values:
+                continue
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted(s["labels"].items())
+            )
+            rows.append({
+                "host": h, "labels": labels, "points": len(values),
+                "last": values[-1], "max": max(values), "values": values,
+            })
+    # worst host first: the row whose series last sampled highest tops
+    # the table (the status/top sick-host-on-top convention)
+    rows.sort(key=lambda r: -r["last"])
+    if as_json:
+        print(json.dumps({
+            "cmd": "history", "metric": metric, "window_secs": window,
+            "pass": bool(rows),
+            "series": [{k: r[k] for k in ("host", "labels", "points",
+                                          "last", "max", "values")}
+                       for r in rows],
+        }, sort_keys=True))
+        return 0 if rows else 1
+    if rows:
+        _table(
+            [[r["host"], r["labels"] or "-", r["points"],
+              f"{r['last']:g}", f"{r['max']:g}", _sparkline(r["values"])]
+             for r in rows],
+            ["HOST", "LABELS", "PTS", "LAST", "MAX", "HISTORY"],
+        )
+    else:
+        log(f"history: no points for {metric!r} on any reachable host "
+            "(unknown metric, empty window, or AIOS_TPU_TSDB unarmed)")
+    print(json.dumps({
+        "cmd": "history", "metric": metric, "window_secs": window,
+        "hosts": len({r["host"] for r in rows}), "series": len(rows),
+        "pass": bool(rows),
+    }, sort_keys=True))
+    return 0 if rows else 1
 
 
 def cmd_drain_check(data: dict, host: str) -> int:
@@ -287,12 +433,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="fleetctl", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("cmd", choices=["status", "top", "drain-check",
-                                    "drain"])
+    ap.add_argument("cmd", choices=["status", "top", "history",
+                                    "drain-check", "drain"])
+    ap.add_argument("metric", nargs="?", default="",
+                    help="history: the metric name to render")
     ap.add_argument("--target", default=default_target(),
                     help="any member's metrics endpoint (host:port)")
     ap.add_argument("--host", default="",
-                    help="host id to drain-check / drain")
+                    help="host id to drain-check / drain / narrow "
+                         "history to")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="history: trailing range in seconds")
     ap.add_argument("--timeout", type=float, default=5.0,
                     help="fetch timeout; for drain, also the bound on "
                          "waiting for the leaving phase")
@@ -300,6 +451,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="status/top: full row set as one JSON document "
                          "on stdout instead of the table + verdict")
     args = ap.parse_args(argv)
+    if args.cmd == "history":
+        if not args.metric:
+            ap.error("history requires a metric name")
+        return cmd_history(args.target, args.metric, args.host,
+                           args.window, args.timeout,
+                           as_json=args.as_json)
     if args.cmd == "drain":
         if not args.host:
             ap.error("drain requires --host")

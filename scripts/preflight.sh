@@ -65,7 +65,21 @@
 #                                  (aios_tpu/faults/net.py,
 #                                  aios_tpu/fleet/breaker.py,
 #                                  aios_tpu/fleet/drain.py,
-#                                  docs/FAULTS.md, docs/RUNBOOK.md §11).
+#                                  docs/FAULTS.md, docs/RUNBOOK.md §11);
+#   9. the incident smoke         — scripts/incident_smoke.py: two
+#                                  processes with the tsdb ring +
+#                                  incident store armed, one seeded with
+#                                  a fault storm — the fired crash must
+#                                  freeze an incident bundle carrying
+#                                  the fault journal AND a non-empty
+#                                  tsdb window, /debug/tsdb/fleet must
+#                                  federate both hosts, and fleetctl
+#                                  history must exit 0; run twice,
+#                                  verdicts identical
+#                                  (aios_tpu/obs/tsdb.py,
+#                                  aios_tpu/obs/incidents.py,
+#                                  docs/OBSERVABILITY.md,
+#                                  docs/RUNBOOK.md §12).
 #
 # The devprof threshold here is looser than benchdiff's default: the
 # committed baseline was captured on a different run of a noisy shared-
@@ -83,35 +97,39 @@ threshold="${PREFLIGHT_DEVPROF_THRESHOLD:-0.75}"
 workdir="$(mktemp -d)"
 trap 'rm -rf "$workdir"' EXIT
 
-echo "[preflight 1/8] static analysis (scripts/analyze.sh)" >&2
+echo "[preflight 1/9] static analysis (scripts/analyze.sh)" >&2
 scripts/analyze.sh
 
-echo "[preflight 2/8] obs-lint subset (tests/test_obs_lint.py)" >&2
+echo "[preflight 2/9] obs-lint subset (tests/test_obs_lint.py)" >&2
 python -m pytest tests/test_obs_lint.py -q -p no:cacheprovider
 
-echo "[preflight 3/8] seeded chaos storm (bench.py --chaos; plain/draft/longctx/mega arms)" >&2
+echo "[preflight 3/9] seeded chaos storm (bench.py --chaos; plain/draft/longctx/mega arms)" >&2
 python bench.py --chaos > "$workdir/chaos.json"
 
-echo "[preflight 4/8] devprof sentinel (bench.py --devprof vs" \
+echo "[preflight 4/9] devprof sentinel (bench.py --devprof vs" \
      "BASELINE_DEVPROF.json, threshold +${threshold})" >&2
 python bench.py --devprof > "$workdir/devprof.json"
 python scripts/benchdiff.py BASELINE_DEVPROF.json \
     "$workdir/devprof.json" --threshold "$threshold"
 
-echo "[preflight 5/8] storm smoke (bench.py --storm --smoke," \
+echo "[preflight 5/9] storm smoke (bench.py --storm --smoke," \
      "seeded, run twice, deterministic verdict)" >&2
 python bench.py --storm --smoke > "$workdir/storm.json"
 
-echo "[preflight 6/8] fleet smoke (scripts/fleet_smoke.py: two" \
+echo "[preflight 6/9] fleet smoke (scripts/fleet_smoke.py: two" \
      "processes federate + stitch, one dies, journals identical)" >&2
 python scripts/fleet_smoke.py > "$workdir/fleet.json"
 
-echo "[preflight 7/8] disagg smoke (scripts/disagg_smoke.py: prefill" \
+echo "[preflight 7/9] disagg smoke (scripts/disagg_smoke.py: prefill" \
      "+ 2 decode processes, kill + resume, token-identical twice)" >&2
 python scripts/disagg_smoke.py > "$workdir/disagg.json"
 
-echo "[preflight 8/8] partition smoke (scripts/partition_smoke.py:" \
+echo "[preflight 8/9] partition smoke (scripts/partition_smoke.py:" \
      "per-edge faults, quarantine, graceful drain, identical twice)" >&2
 python scripts/partition_smoke.py > "$workdir/partition.json"
+
+echo "[preflight 9/9] incident smoke (scripts/incident_smoke.py: seeded" \
+     "fault storm -> replayable incident bundles, identical twice)" >&2
+python scripts/incident_smoke.py > "$workdir/incidents.json"
 
 echo "[preflight] PASS" >&2

@@ -552,7 +552,7 @@ def test_recorder_event_kinds_bounded():
     from aios_tpu.fleet import drain as fleet_drain
     from aios_tpu.fleet import kvx as fleet_kvx
     from aios_tpu.fleet import router as fleet_router
-    from aios_tpu.obs import fleet, flightrec
+    from aios_tpu.obs import fleet, flightrec, incidents, tsdb
     from aios_tpu.runtime import service as runtime_service
     from aios_tpu.serving import autoscale, failover, pool
 
@@ -560,6 +560,7 @@ def test_recorder_event_kinds_bounded():
         batching, engine_mod, pool, runtime_service, flightrec,
         failover, faults_inject, faults_net, autoscale, fleet,
         fleet_breaker, fleet_disagg, fleet_drain, fleet_kvx, fleet_router,
+        incidents, tsdb,
     )
     assert kinds, "no recorder event call sites found"
     unknown = kinds - set(flightrec.EVENT_KINDS)
@@ -881,6 +882,135 @@ def test_failover_outcomes_closed_enum():
                 outcomes.add(kw.value.value)
     assert outcomes, "no failover outcome call sites found"
     assert outcomes <= set(failover.FAILOVER_OUTCOMES)
+
+
+# -- the tsdb + incident families (obs/tsdb.py, obs/incidents.py, ISSUE 20) -
+
+# The black-box ring's self-accounting: sample passes and per-verb query
+# counts are monotonic counters; the live/dropped series counts are
+# gauges (they can fall on clear()). Any NEW aios_tpu_tsdb_* metric must
+# be added here (and to docs/OBSERVABILITY.md) so the family stays
+# reviewed.
+TSDB_EXPECTED = {
+    "aios_tpu_tsdb_sample_passes_total": ("counter", ()),
+    "aios_tpu_tsdb_series_total": ("gauge", ()),
+    "aios_tpu_tsdb_dropped_series_total": ("gauge", ()),
+    "aios_tpu_tsdb_queries_total": ("counter", ("verb",)),
+}
+
+INCIDENTS_EXPECTED = {
+    "aios_tpu_incidents_total": ("counter", ("cause",)),
+    "aios_tpu_incidents_suppressed_total": ("counter", ("cause",)),
+}
+
+
+def test_tsdb_family_complete_and_typed():
+    family = {
+        m.name: (m.kind, tuple(m.labelnames)) for m in _catalog()
+        if m.name.startswith("aios_tpu_tsdb_")
+    }
+    assert family == TSDB_EXPECTED
+
+
+def test_incidents_family_complete_and_typed():
+    family = {
+        m.name: (m.kind, tuple(m.labelnames)) for m in _catalog()
+        if m.name.startswith("aios_tpu_incidents_")
+    }
+    assert family == INCIDENTS_EXPECTED
+
+
+def test_tsdb_query_verbs_closed_and_iterated_at_registration():
+    """The ``verb`` label values come from the closed tsdb.QUERY_VERBS
+    tuple and nowhere else: the ring pre-registers every verb child by
+    iterating the enum (the autoscale/SLO registration pattern), and
+    query() validates against the same tuple — so a new query verb is a
+    reviewed enum change, never a stray label value."""
+    from aios_tpu.analysis.core import module_info_for, names_used_in
+    from aios_tpu.obs import tsdb
+
+    assert tsdb.QUERY_VERBS == (
+        "raw", "rate", "avg", "min", "max", "p50", "p90", "p95", "p99",
+    )
+    assert tsdb.SERIES_KINDS == ("delta", "gauge")
+    mi = module_info_for(tsdb)
+    assert "QUERY_VERBS" in names_used_in(
+        mi.functions["Tsdb._register_metrics"].node
+    ), "tsdb query children must be pre-registered by iterating QUERY_VERBS"
+    assert "QUERY_VERBS" in names_used_in(mi.functions["Tsdb.query"].node), (
+        "query() must validate verbs against the same closed enum"
+    )
+
+
+def test_incident_trigger_causes_closed_and_iterated_at_registration():
+    """The ``cause`` label values come from the closed
+    incidents.TRIGGER_CAUSES tuple and nowhere else: the store
+    pre-registers every cause child by iterating the enum, notify()
+    normalizes unknown strings onto it, every literal a trigger hook
+    hands to notify() is a member (checked on the AST across the three
+    non-flightrec hooks), and the flightrec snapshot causes — which ride
+    through notify() verbatim — are a subset."""
+    from aios_tpu.analysis.core import (
+        module_info_for, names_used_in, string_call_args,
+    )
+    from aios_tpu.faults import inject as faults_inject
+    from aios_tpu.fleet import breaker as fleet_breaker
+    from aios_tpu.obs import flightrec, incidents
+    from aios_tpu.serving import autoscale
+
+    assert incidents.TRIGGER_CAUSES == (
+        "abort", "autoscale", "breaker_open", "crash_respawn", "fault",
+        "manual", "shed_spike", "slo_breach",
+    )
+    mi = module_info_for(incidents)
+    assert "TRIGGER_CAUSES" in names_used_in(
+        mi.functions["IncidentStore._register_metrics"].node
+    ), "incident children must be pre-registered by iterating the enum"
+    assert "TRIGGER_CAUSES" in names_used_in(
+        mi.functions["IncidentStore.notify"].node
+    ), "notify() must normalize causes against the same closed enum"
+    causes = set()
+    for mod in (autoscale, fleet_breaker, faults_inject):
+        hmi = module_info_for(mod)
+        causes |= {
+            lit for lit, _ in string_call_args(hmi.tree, ("notify",), 1)
+        }
+    assert causes == {"autoscale", "breaker_open", "fault"}, (
+        f"trigger hooks emit causes {sorted(causes)} — each hook owns "
+        f"exactly one TRIGGER_CAUSES member"
+    )
+    assert set(flightrec.SNAPSHOT_CAUSES) <= set(incidents.TRIGGER_CAUSES), (
+        "snapshot causes ride through notify() verbatim, so every one "
+        "must be a TRIGGER_CAUSES member"
+    )
+
+
+def test_debug_route_index_complete():
+    """Every route the HTTP handler dispatches on (the ``path == "/..."``
+    comparisons, collected on the AST) appears in the ROUTES index that
+    GET /debug renders, and vice versa — a new endpoint that skips the
+    index fails here."""
+    import ast as ast_mod
+
+    from aios_tpu.analysis.core import module_info_for
+    from aios_tpu.obs import http as http_mod
+
+    mi = module_info_for(http_mod)
+    dispatched = set()
+    for node in ast_mod.walk(mi.tree):
+        if not isinstance(node, ast_mod.Compare):
+            continue
+        for cand in [node.left, *node.comparators]:
+            if isinstance(cand, ast_mod.Constant) and isinstance(
+                cand.value, str
+            ) and cand.value.startswith("/"):
+                dispatched.add(cand.value)
+    indexed = {route for _, route, _ in http_mod.ROUTES}
+    assert dispatched == indexed, (
+        f"route index out of sync: dispatched-but-unindexed "
+        f"{sorted(dispatched - indexed)}, indexed-but-undispatched "
+        f"{sorted(indexed - dispatched)}"
+    )
 
 
 def test_serving_label_conventions():

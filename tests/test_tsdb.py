@@ -1,0 +1,479 @@
+"""Black-box time-series ring units (aios_tpu/obs/tsdb.py, ISSUE 20).
+
+Deterministic tier: config/arming, the sampler's delta/gauge/histogram
+flattening on an injected clock, ring -> wheel downsample math, counter
+resets, the cardinality-cap drop accounting, the closed-verb query form,
+the window snapshot incidents freeze, and the HTTP surface (including
+the /debug route index). One engine-backed test pins the ON/OFF
+invariant: a pipelined batcher's token stream is identical with the
+sampler thread running hot.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from aios_tpu.obs import tsdb
+from aios_tpu.obs.metrics import Counter, Gauge, Histogram, MetricsRegistry
+from aios_tpu.obs.tsdb import Tsdb, TsdbConfig
+
+
+def _cfg(**kw) -> TsdbConfig:
+    cfg = TsdbConfig()
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _ring(now, registry, **kw) -> Tsdb:
+    return Tsdb(cfg=_cfg(**kw), registry=registry,
+                clock=lambda: now[0])
+
+
+# -- config / arming --------------------------------------------------------
+
+
+def test_config_defaults_off(monkeypatch):
+    for var in ("AIOS_TPU_TSDB", "AIOS_TPU_TSDB_STEP_SECS",
+                "AIOS_TPU_TSDB_MAX_SERIES"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = TsdbConfig()
+    assert not cfg.enabled
+    assert cfg.step_secs == 1.0
+    assert cfg.raw_secs == 300.0
+    assert cfg.wheel_step_secs == 10.0
+    assert cfg.wheel_secs == 3600.0
+    assert cfg.max_series == 4096
+    assert cfg.raw_slots == 300
+    assert cfg.wheel_slots == 360
+
+
+def test_config_env_parsing_and_clamps(monkeypatch):
+    monkeypatch.setenv("AIOS_TPU_TSDB", "1")
+    monkeypatch.setenv("AIOS_TPU_TSDB_STEP_SECS", "0.5")
+    monkeypatch.setenv("AIOS_TPU_TSDB_RAW_SECS", "60")
+    monkeypatch.setenv("AIOS_TPU_TSDB_WHEEL_STEP_SECS", "5")
+    monkeypatch.setenv("AIOS_TPU_TSDB_WHEEL_SECS", "600")
+    monkeypatch.setenv("AIOS_TPU_TSDB_MAX_SERIES", "128")
+    cfg = TsdbConfig()
+    assert cfg.enabled
+    assert (cfg.step_secs, cfg.raw_secs) == (0.5, 60.0)
+    assert (cfg.wheel_step_secs, cfg.wheel_secs) == (5.0, 600.0)
+    assert cfg.max_series == 128
+    assert cfg.raw_slots == 120
+    monkeypatch.setenv("AIOS_TPU_TSDB_STEP_SECS", "0.0001")  # clamps
+    assert TsdbConfig().step_secs == 0.05
+    monkeypatch.setenv("AIOS_TPU_TSDB_STEP_SECS", "oops")  # default
+    assert TsdbConfig().step_secs == 1.0
+
+
+def test_maybe_start_noop_when_unarmed(monkeypatch):
+    monkeypatch.delenv("AIOS_TPU_TSDB", raising=False)
+    prev = tsdb.install(None)
+    try:
+        assert tsdb.maybe_start() is None
+        assert tsdb.TSDB is None and not tsdb.enabled()
+        assert tsdb.trend("aios_tpu_whatever_total") is None
+    finally:
+        tsdb.install(prev)
+
+
+def test_maybe_start_arms_and_is_idempotent(monkeypatch):
+    monkeypatch.setenv("AIOS_TPU_TSDB", "1")
+    monkeypatch.setenv("AIOS_TPU_TSDB_STEP_SECS", "30")
+    prev = tsdb.install(None)
+    try:
+        ring = tsdb.maybe_start()
+        assert ring is not None and tsdb.enabled()
+        assert tsdb.maybe_start() is ring  # second call: the same ring
+        ring.stop()
+    finally:
+        tsdb.install(prev)
+
+
+# -- sampler semantics ------------------------------------------------------
+
+
+def test_counters_sample_as_deltas_gauges_raw():
+    reg = MetricsRegistry()
+    c = Counter("aios_tpu_t_flow_total", "h", registry=reg)
+    g = Gauge("aios_tpu_t_level_ratio", "h", registry=reg)
+    now = [100.0]
+    ring = _ring(now, reg)
+    g.set(7.0)
+    ring.sample_once()  # counter pass 1: prev only, no point yet
+    for _ in range(3):
+        now[0] += 1.0
+        c.inc(5.0)
+        g.set(now[0])
+        ring.sample_once()
+    got = ring.query("aios_tpu_t_flow_total", verb="raw", window=60)
+    (s,) = got["series"]
+    assert s["kind"] == "delta"
+    assert [v for _, v in s["points"]] == [5.0, 5.0, 5.0]
+    got = ring.query("aios_tpu_t_level_ratio", verb="raw", window=60)
+    (s,) = got["series"]
+    assert s["kind"] == "gauge"
+    assert [v for _, v in s["points"]] == [7.0, 101.0, 102.0, 103.0]
+
+
+def test_labeled_children_become_distinct_series():
+    reg = MetricsRegistry()
+    c = Counter("aios_tpu_t_req_total", "h", ("model",), registry=reg)
+    c.labels(model="a").inc(2.0)
+    c.labels(model="b").inc(3.0)
+    now = [0.0]
+    ring = _ring(now, reg)
+    ring.sample_once()
+    now[0] += 1.0
+    c.labels(model="a").inc(4.0)
+    ring.sample_once()
+    got = ring.query("aios_tpu_t_req_total", verb="rate", window=1)
+    by_label = {s["labels"]["model"]: s["value"] for s in got["series"]}
+    assert by_label == {"a": 4.0, "b": 0.0}
+    # matchers narrow the selection
+    got = ring.query("aios_tpu_t_req_total", {"model": "a"}, verb="rate",
+                     window=1)
+    assert len(got["series"]) == 1
+
+
+def test_counter_reset_respawn_becomes_delta_not_negative_spike():
+    """A respawned process's counter restarts from zero: the sampled
+    total DROPS. rate() must fold the new total in as the delta since
+    the reset — never a negative rate (the Prometheus reset rule)."""
+    reg = MetricsRegistry()
+    c = Counter("aios_tpu_t_reset_total", "h", registry=reg)
+    now = [0.0]
+    ring = _ring(now, reg)
+    c.inc(100.0)
+    ring.sample_once()          # prev = 100
+    now[0] += 1.0
+    c.inc(10.0)
+    ring.sample_once()          # delta 10
+    # simulate the respawn: a FRESH registry child starting over
+    with c._lock:
+        c._children[()].__init__()
+    c.inc(3.0)
+    now[0] += 1.0
+    ring.sample_once()          # 3 < 110 -> delta = 3 (the new total)
+    got = ring.query("aios_tpu_t_reset_total", verb="raw", window=60)
+    assert [v for _, v in got["series"][0]["points"]] == [10.0, 3.0]
+    got = ring.query("aios_tpu_t_reset_total", verb="rate", window=2)
+    assert got["series"][0]["value"] == pytest.approx(13.0 / 2)
+
+
+def test_nan_fn_gauge_skipped():
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_sick_ratio", "h", registry=reg)
+    g.set_function(lambda: 1 / 0)  # value property -> nan
+    ring = _ring([0.0], reg)
+    assert ring.sample_once() == 0
+    assert ring.series_count() == 0
+
+
+# -- ring -> wheel downsample math ------------------------------------------
+
+
+def test_wheel_downsample_math_vs_injected_clock():
+    """Raw ring 10 x 1s, wheel 10s buckets: after 40 passes the query
+    window is served by raw points for the recent 10s and flushed wheel
+    buckets (gauge: bucket average; delta: bucket sum) for the rest —
+    and the numbers are EXACT."""
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_wave_ratio", "h", registry=reg)
+    c = Counter("aios_tpu_t_tick_total", "h", registry=reg)
+    now = [0.0]
+    ring = _ring(now, reg, step_secs=1.0, raw_secs=10.0,
+                 wheel_step_secs=10.0, wheel_secs=100.0)
+    for t in range(40):
+        now[0] = float(t)
+        g.set(float(t))
+        c.inc(1.0)
+        ring.sample_once()
+    got = ring.query("aios_tpu_t_wave_ratio", verb="raw", window=40)
+    pts = got["series"][0]["points"]
+    # raw covers t=30..39; flushed buckets 0/10/20 render their average
+    assert pts[:3] == [[0.0, 4.5], [10.0, 14.5], [20.0, 24.5]]
+    assert [v for _, v in pts[3:]] == [float(t) for t in range(30, 40)]
+    assert got["series"][0]["kind"] == "gauge"
+    avg = ring.query("aios_tpu_t_wave_ratio", verb="avg", window=40)
+    expect = (4.5 + 14.5 + 24.5 + sum(range(30, 40))) / 13
+    assert avg["series"][0]["value"] == pytest.approx(expect)
+    assert ring.query("aios_tpu_t_wave_ratio", verb="min",
+                      window=40)["series"][0]["value"] == 4.5
+    assert ring.query("aios_tpu_t_wave_ratio", verb="max",
+                      window=40)["series"][0]["value"] == 39.0
+    # delta series: wheel buckets render the SUM (counts, not averages)
+    got = ring.query("aios_tpu_t_tick_total", verb="raw", window=40)
+    pts = got["series"][0]["points"]
+    # pass 0 only set prev; bucket 0 holds 9 deltas, 10/20 hold 10
+    assert pts[:3] == [[0.0, 9.0], [10.0, 10.0], [20.0, 20.0 - 10.0]]
+    rate = ring.query("aios_tpu_t_tick_total", verb="rate", window=40)
+    assert rate["series"][0]["value"] == pytest.approx(39.0 / 40)
+
+
+def test_raw_ring_is_bounded():
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_b_ratio", "h", registry=reg)
+    now = [0.0]
+    ring = _ring(now, reg, step_secs=1.0, raw_secs=5.0)
+    for t in range(50):
+        now[0] = float(t)
+        g.set(1.0)
+        ring.sample_once()
+    with ring._lock:
+        (s,) = [x for x in ring._series.values()
+                if x.name == "aios_tpu_t_b_ratio"]
+        assert len(s.raw) == 5
+
+
+def test_histogram_buckets_count_sum_and_quantile():
+    reg = MetricsRegistry()
+    h = Histogram("aios_tpu_t_lat_seconds", "h",
+                  buckets=(0.1, 1.0, 10.0), registry=reg)
+    now = [0.0]
+    ring = _ring(now, reg)
+    ring.sample_once()  # zero baseline (prev)
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    now[0] += 1.0
+    ring.sample_once()
+    names = {s.name for s in ring._series.values()}
+    assert {"aios_tpu_t_lat_seconds_bucket",
+            "aios_tpu_t_lat_seconds_count",
+            "aios_tpu_t_lat_seconds_sum"} <= names
+    got = ring.query("aios_tpu_t_lat_seconds_sum", verb="rate", window=1)
+    assert got["series"][0]["value"] == pytest.approx(6.05)
+    # p50: rank 2 of 4 lands in the (0.1, 1.0] bucket, interpolated
+    got = ring.query("aios_tpu_t_lat_seconds", verb="p50", window=60)
+    (s,) = got["series"]
+    assert s["samples"] == 4.0
+    assert s["value"] == pytest.approx(0.1 + (1.0 - 0.1) * 0.5)
+    # p99: rank 3.96 of 4 interpolates inside the (1.0, 10.0] bucket
+    got = ring.query("aios_tpu_t_lat_seconds", verb="p99", window=60)
+    assert got["series"][0]["value"] == pytest.approx(
+        1.0 + (10.0 - 1.0) * 0.96
+    )
+
+
+# -- cardinality cap --------------------------------------------------------
+
+
+def test_cardinality_cap_counts_each_dropped_series_once():
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_many_ratio", "h", ("k",), registry=reg)
+    for i in range(24):
+        g.labels(k=str(i)).set(float(i))
+    now = [0.0]
+    ring = _ring(now, reg, max_series=16)
+    ring.sample_once()
+    assert ring.series_count() == 16
+    assert ring.dropped_series() == 8
+    # the SAME series dropping again on later passes is not re-counted
+    now[0] += 1.0
+    ring.sample_once()
+    assert ring.dropped_series() == 8
+    # a genuinely new series past the cap adds exactly one more
+    g.labels(k="late").set(1.0)
+    now[0] += 1.0
+    ring.sample_once()
+    assert ring.dropped_series() == 9
+    assert ring.stats()["dropped_series"] == 9
+
+
+# -- queries ----------------------------------------------------------------
+
+
+def test_unknown_verb_raises_listing_the_enum():
+    ring = _ring([0.0], MetricsRegistry())
+    with pytest.raises(ValueError, match="raw, rate, avg"):
+        ring.query("aios_tpu_x_total", verb="sum")
+
+
+def test_rate_on_gauge_is_none():
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_g_ratio", "h", registry=reg)
+    g.set(5.0)
+    now = [0.0]
+    ring = _ring(now, reg)
+    ring.sample_once()
+    got = ring.query("aios_tpu_t_g_ratio", verb="rate", window=10)
+    assert got["series"][0]["value"] is None
+
+
+def test_window_snapshot_bounded_with_explicit_truncation():
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_snap_ratio", "h", ("k",), registry=reg)
+    for i in range(8):
+        g.labels(k=str(i)).set(float(i))
+    now = [10.0]
+    ring = _ring(now, reg)
+    ring.sample_once()
+    snap = ring.window_snapshot(0.0, 20.0, max_series=5)
+    assert len(snap["series"]) == 5
+    assert snap["truncated"] == 3
+    assert snap["start"] == 0.0 and snap["end"] == 20.0
+    assert all(s["points"] for s in snap["series"])
+
+
+def test_trend_reads_worst_series():
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_burn_ratio", "h", ("model",), registry=reg)
+    now = [0.0]
+    ring = _ring(now, reg)
+    prev = tsdb.install(ring)
+    try:
+        for t in range(3):
+            now[0] = float(t)
+            g.labels(model="cool").set(0.1)
+            g.labels(model="hot").set(float(t))
+            ring.sample_once()
+        got = tsdb.trend("aios_tpu_t_burn_ratio", window=60)
+        assert got["last"] == 2.0 and got["first"] == 0.0
+        assert got["points"] == 3
+        assert tsdb.trend("aios_tpu_no_such_total") is None
+    finally:
+        tsdb.install(prev)
+
+
+def test_handle_query_form():
+    reg = MetricsRegistry()
+    c = Counter("aios_tpu_t_hq_total", "h", ("model",), registry=reg)
+    c.labels(model="m").inc(1.0)
+    now = [0.0]
+    ring = _ring(now, reg)
+    ring.sample_once()
+    now[0] += 1.0
+    c.labels(model="m").inc(1.0)
+    ring.sample_once()
+    prev = tsdb.install(ring)
+    try:
+        payload, status = tsdb.handle_query({})
+        assert status == 200 and payload["stats"]["series"] == 1
+        payload, status = tsdb.handle_query({
+            "name": ["aios_tpu_t_hq_total"], "verb": ["rate"],
+            "window": ["1"], "match": ["model:m"],
+        })
+        assert status == 200
+        assert payload["series"][0]["value"] == pytest.approx(1.0)
+        _, status = tsdb.handle_query({"name": ["x"], "verb": ["nope"]})
+        assert status == 400
+        _, status = tsdb.handle_query({"name": ["x"], "match": ["bad"]})
+        assert status == 400
+        _, status = tsdb.handle_query({"name": ["x"], "window": ["z"]})
+        assert status == 400
+    finally:
+        tsdb.install(prev)
+    payload, status = tsdb.handle_query({}) if tsdb.TSDB is None else ({}, 0)
+    if status:  # unarmed process: the 404 names the arming knob
+        assert status == 404 and "AIOS_TPU_TSDB" in payload["error"]
+
+
+# -- HTTP surface -----------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def test_debug_tsdb_http_and_route_index():
+    from aios_tpu.obs import http as obs_http
+    from aios_tpu.obs.http import start_metrics_server
+
+    reg = MetricsRegistry()
+    g = Gauge("aios_tpu_t_http_ratio", "h", registry=reg)
+    g.set(3.0)
+    ring = _ring([0.0], reg)
+    ring.sample_once()
+    prev = tsdb.install(ring)
+    server, port = start_metrics_server(port=0)
+    try:
+        status, body = _get(
+            port, "/debug/tsdb?name=aios_tpu_t_http_ratio&verb=max"
+        )
+        assert status == 200
+        assert json.loads(body)["series"][0]["value"] == 3.0
+        status, body = _get(port, "/debug/tsdb")
+        assert status == 200 and json.loads(body)["stats"]["series"] == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/debug/tsdb?name=x&verb=nope")
+        assert ei.value.code == 400
+        # the /debug index lists every registered route
+        status, body = _get(port, "/debug")
+        assert status == 200
+        listed = {(r["method"], r["route"])
+                  for r in json.loads(body)["routes"]}
+        assert listed == {(m, r) for m, r, _ in obs_http.ROUTES}
+        assert all(h for _, _, h in obs_http.ROUTES)
+    finally:
+        tsdb.install(prev)
+        server.shutdown()
+
+
+def test_debug_tsdb_404_when_unarmed():
+    from aios_tpu.obs.http import start_metrics_server
+
+    prev = tsdb.install(None)
+    server, port = start_metrics_server(port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/debug/tsdb")
+        assert ei.value.code == 404
+    finally:
+        tsdb.install(prev)
+        server.shutdown()
+
+
+# -- the ON/OFF invariant (engine tier) -------------------------------------
+
+
+def test_sampler_on_off_token_streams_identical():
+    """The acceptance invariant at unit scale: a pipelined batcher's
+    greedy token streams are bit-identical with the sampler thread
+    running hot against the process registry — the ring only READS
+    instruments, it never perturbs scheduling."""
+    import jax
+    import jax.numpy as jnp
+
+    from aios_tpu.engine import model as model_mod
+    from aios_tpu.engine.batching import ContinuousBatcher, Request
+    from aios_tpu.engine.config import TINY_TEST
+    from aios_tpu.engine.engine import TPUEngine
+
+    cfg = TINY_TEST.scaled(name="tsdb-onoff", max_context=128)
+    params = model_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+    engine = TPUEngine(cfg, params, num_slots=2, max_context=128,
+                       cache_dtype=jnp.float32)
+    b = ContinuousBatcher(engine, chunk_steps=2, admit_chunk_steps=2,
+                          pipeline=True)
+
+    def wave(tag):
+        handles = [
+            b.submit(Request(prompt_ids=[3 + i, 7, 11], max_tokens=12,
+                             temperature=0.0,
+                             request_id=f"{tag}-{i}"))
+            for i in range(4)
+        ]
+        return [h.tokens() for h in handles]
+
+    try:
+        off = wave("off")
+        ring = Tsdb(cfg=_cfg(step_secs=0.01))  # global registry, hot
+        prev = tsdb.install(ring)
+        ring.start()
+        try:
+            on = wave("on")
+        finally:
+            ring.stop()
+            tsdb.install(prev)
+        assert on == off, "tsdb sampling must not perturb decode"
+        assert ring.stats()["passes"] > 0, "the sampler never ran"
+        assert ring.series_count() > 0
+    finally:
+        b.shutdown()
